@@ -1,0 +1,6 @@
+from bolt_tpu.parallel.mesh import (default_mesh, ensure_auto,
+                                    initialize_distributed, make_mesh)
+from bolt_tpu.parallel.sharding import key_sharding, reshard
+
+__all__ = ["default_mesh", "ensure_auto", "make_mesh",
+           "initialize_distributed", "key_sharding", "reshard"]

@@ -1,0 +1,302 @@
+"""Chunking: block decomposition of the value axes.
+
+Reference: ``bolt/spark/chunk.py :: ChunkedArray`` — records re-keyed to
+``((key-tuple, chunk-id-tuple), block)`` with a per-value-axis ``plan`` of
+chunk sizes (MB budget or explicit), optional halo ``padding``, per-block
+``map``, shuffle-based ``unchunk``, and the ``keys_to_values`` /
+``values_to_keys`` axis-exchange primitives behind ``swap`` (symbol-level
+citations, SURVEY.md §0).
+
+TPU-native design: the underlying array already lives sharded on the mesh,
+so a ``ChunkedArray`` is a **thin view** (the BASELINE north-star's words) —
+``chunk()`` records a plan without moving a byte, ``unchunk()`` returns the
+wrapped array, and only ``map`` launches a compiled program: the uniform
+no-padding path reshapes value axes into (grid, block) pairs and nested-
+``vmap``s the function over keys+grid (one fused SPMD launch); the general
+path (ragged tails, halo padding) unrolls the static chunk grid at trace
+time with clamped padded slices, trims the halo after ``func``, and
+reassembles with the same recursive concatenate tree the reference's
+``unchunk`` uses — all still inside one jit.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit, _constrain, _traceable
+from bolt_tpu.utils import iterexpand, prod, tupleize
+
+
+class ChunkedArray:
+    """A chunk-plan view over a :class:`BoltArrayTPU`."""
+
+    def __init__(self, barray, plan, padding):
+        self._barray = barray
+        self._plan = tuple(int(p) for p in plan)
+        self._padding = tuple(int(p) for p in padding)
+
+    # ------------------------------------------------------------------
+    # construction (reference: ``ChunkedArray._chunk``)
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def chunk(cls, barray, size="150", axis=None, padding=None):
+        """Compute the chunk ``plan``.
+
+        ``size``: a string is a per-block megabyte budget (the reference's
+        ``size='150'`` default) — the largest chunkable axis is halved until
+        the block fits; an int/tuple gives explicit chunk sizes for the
+        chosen ``axis`` set.  ``padding`` adds a halo (elements borrowed
+        from neighbouring chunks, clipped at the array edge) on the chunked
+        axes.
+        """
+        split = barray.split
+        vshape = barray.shape[split:]
+        nv = len(vshape)
+        if axis is None:
+            axes = tuple(range(nv))
+        else:
+            axes = tuple(sorted(tupleize(axis)))
+            for a in axes:
+                if a < 0 or a >= nv:
+                    raise ValueError(
+                        "chunk axis %d out of range for %d value axes" % (a, nv))
+
+        plan = list(vshape)
+        if isinstance(size, str):
+            budget = float(size) * 1e6
+            itemsize = barray.dtype.itemsize
+            while (prod(plan) * itemsize > budget
+                   and any(plan[a] > 1 for a in axes)):
+                a = max(axes, key=lambda i: plan[i])
+                plan[a] = -(-plan[a] // 2)
+        else:
+            sizes = iterexpand(size, len(axes))
+            for a, s in zip(axes, sizes):
+                if s < 1:
+                    raise ValueError("chunk size must be >= 1, got %d" % s)
+                plan[a] = min(int(s), vshape[a])
+
+        pad = [0] * nv
+        if padding is not None:
+            pads = iterexpand(padding, len(axes))
+            for a, p in zip(axes, pads):
+                if p < 0 or (p > 0 and p >= plan[a]):
+                    raise ValueError(
+                        "padding %d must be smaller than the chunk size %d "
+                        "on axis %d" % (p, plan[a], a))
+                pad[a] = int(p)
+        return cls(barray, plan, pad)
+
+    # ------------------------------------------------------------------
+    # properties (reference: ``ChunkedArray.plan/padding/kshape/vshape/
+    # uniform``)
+    # ------------------------------------------------------------------
+
+    @property
+    def plan(self):
+        return self._plan
+
+    @property
+    def padding(self):
+        return self._padding
+
+    @property
+    def kshape(self):
+        b = self._barray
+        return b.shape[:b.split]
+
+    @property
+    def vshape(self):
+        b = self._barray
+        return b.shape[b.split:]
+
+    @property
+    def shape(self):
+        return self._barray.shape
+
+    @property
+    def split(self):
+        return self._barray.split
+
+    @property
+    def dtype(self):
+        return self._barray.dtype
+
+    @property
+    def mode(self):
+        return "tpu"
+
+    @property
+    def grid(self):
+        """Number of chunks along each value axis."""
+        return tuple(-(-v // c) for v, c in zip(self.vshape, self._plan))
+
+    @property
+    def uniform(self):
+        """True when every chunk has the same shape (no ragged tail)."""
+        return all(v % c == 0 for v, c in zip(self.vshape, self._plan))
+
+    # ------------------------------------------------------------------
+    # per-block map (reference: ``ChunkedArray.map`` with padding trim)
+    # ------------------------------------------------------------------
+
+    def map(self, func, value_shape=None, dtype=None):
+        """Apply ``func`` to every chunk of every record; returns a new
+        :class:`ChunkedArray`.
+
+        With no padding and a uniform plan, ``func`` may change the block
+        shape (rank-preserving — e.g. the per-chunk SVD of BASELINE config
+        5); with padding or a ragged tail, ``func`` must preserve the block
+        shape so the halo can be trimmed and the tiles reassembled.
+        """
+        func = _traceable(func)
+        b = self._barray
+        split = b.split
+        mesh = b.mesh
+        kshape = self.kshape
+        vshape = self.vshape
+        nv = len(vshape)
+        plan = self._plan
+        pad = self._padding
+        grid = self.grid
+        padded = any(p > 0 for p in pad)
+
+        if self.uniform and not padded:
+            def build():
+                def run(data):
+                    newshape = kshape + tuple(
+                        x for v, c in zip(vshape, plan) for x in (v // c, c))
+                    r = data.reshape(newshape)
+                    g_axes = [split + 2 * i for i in range(nv)]
+                    c_axes = [split + 2 * i + 1 for i in range(nv)]
+                    r = jnp.transpose(
+                        r, tuple(range(split)) + tuple(g_axes) + tuple(c_axes))
+                    f = func
+                    for _ in range(split + nv):
+                        f = jax.vmap(f)
+                    out = f(r)
+                    ob = out.shape[split + nv:]
+                    if len(ob) != nv:
+                        raise ValueError(
+                            "chunked map must preserve block rank: block %s "
+                            "-> %s" % (str(tuple(plan)), str(tuple(ob))))
+                    perm = tuple(range(split)) + tuple(
+                        x for i in range(nv) for x in (split + i, split + nv + i))
+                    out = jnp.transpose(out, perm)
+                    merged = kshape + tuple(g * o for g, o in zip(grid, ob))
+                    out = out.reshape(merged)
+                    return _constrain(out, mesh, split)
+                return jax.jit(run)
+
+            fn = _cached_jit(("chunk-map-u", func, b.shape, str(b.dtype),
+                             split, plan, mesh), build)
+            out = fn(b._data)
+            new_plan = tuple(o // g for o, g in zip(out.shape[split:], grid))
+            return ChunkedArray(BoltArrayTPU(out, split, mesh), new_plan, pad)
+
+        # general path: ragged tails and/or halo padding — static grid
+        # unrolled at trace time, one compiled program
+        def build():
+            def run(data):
+                keyslice = (slice(None),) * split
+
+                def block(gidx):
+                    bounds = []
+                    trims = []
+                    for i, gi in enumerate(gidx):
+                        c0 = gi * plan[i]
+                        c1 = min(vshape[i], c0 + plan[i])
+                        p0 = max(0, c0 - pad[i])
+                        p1 = min(vshape[i], c1 + pad[i])
+                        bounds.append((p0, p1))
+                        trims.append((c0 - p0, c1 - p0))
+                    sl = keyslice + tuple(slice(p0, p1) for p0, p1 in bounds)
+                    blk = data[sl]
+                    out = func(blk)
+                    if out.shape != blk.shape:
+                        raise ValueError(
+                            "with padding or a ragged chunk plan, the mapped "
+                            "function must preserve the block shape; got %s "
+                            "-> %s" % (str(blk.shape), str(out.shape)))
+                    trim = keyslice + tuple(slice(t0, t1) for t0, t1 in trims)
+                    return out[trim]
+
+                def rec(prefix, level):
+                    if level == nv:
+                        return block(tuple(prefix))
+                    parts = [rec(prefix + [i], level + 1)
+                             for i in range(grid[level])]
+                    if len(parts) == 1:
+                        return parts[0]
+                    return jnp.concatenate(parts, axis=split + level)
+
+                out = rec([], 0)
+                return _constrain(out, mesh, split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("chunk-map-g", func, b.shape, str(b.dtype),
+                          split, plan, pad, mesh), build)
+        out = fn(b._data)
+        return ChunkedArray(BoltArrayTPU(out, split, mesh), plan, pad)
+
+    # ------------------------------------------------------------------
+    # axis exchange (reference: ``ChunkedArray.keys_to_values`` /
+    # ``values_to_keys`` — the primitives behind ``swap``)
+    # ------------------------------------------------------------------
+
+    def keys_to_values(self, axes, size=None):
+        """Move key axes into the values (they land at the FRONT of the
+        value group in the order given, matching the swap algebra).  The
+        data movement is the resharding inside ``swap`` — an ``all_to_all``
+        over the mesh.  Moving EVERY key axis is allowed (the reference
+        keeps blocks keyed by chunk ids); the result has ``split=0`` until
+        ``values_to_keys`` restores key axes."""
+        axes = tuple(tupleize(axes))
+        split = self._barray.split
+        for a in axes:
+            if a < 0 or a >= split:
+                raise ValueError(
+                    "key axis %d out of range for split %d" % (a, split))
+        if len(set(axes)) != len(axes):
+            raise ValueError("keys_to_values axes must be unique")
+        swapped = self._barray._do_swap(axes, ())
+        moved = [self._barray.shape[a] for a in axes]
+        if size is not None:
+            sizes = iterexpand(size, len(moved))
+            moved = [min(int(s), m) for s, m in zip(sizes, moved)]
+        new_plan = tuple(moved) + self._plan
+        new_pad = (0,) * len(moved) + self._padding
+        return ChunkedArray(swapped, new_plan, new_pad)
+
+    def values_to_keys(self, axes):
+        """Move value axes into the keys (appended after the existing key
+        axes, matching the swap algebra)."""
+        axes = tuple(tupleize(axes))
+        nv = len(self.vshape)
+        for a in axes:
+            if a < 0 or a >= nv:
+                raise ValueError(
+                    "value axis %d out of range for %d value axes" % (a, nv))
+        swapped = self._barray.swap((), axes)
+        keep = [i for i in range(nv) if i not in axes]
+        new_plan = tuple(self._plan[i] for i in keep)
+        new_pad = tuple(self._padding[i] for i in keep)
+        return ChunkedArray(swapped, new_plan, new_pad)
+
+    # ------------------------------------------------------------------
+
+    def unchunk(self):
+        """Back to a :class:`BoltArrayTPU` — a no-op unwrap: the data never
+        left its assembled, mesh-resident layout (reference:
+        ``ChunkedArray.unchunk`` pays a full shuffle here)."""
+        return self._barray
+
+    def __repr__(self):
+        s = "ChunkedArray\n"
+        s += "mode: tpu\n"
+        s += "shape: %s\n" % str(self.shape)
+        s += "split: %d\n" % self.split
+        s += "plan: %s\n" % str(self._plan)
+        s += "padding: %s\n" % str(self._padding)
+        s += "grid: %s\n" % str(self.grid)
+        return s

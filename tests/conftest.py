@@ -1,0 +1,38 @@
+"""Test configuration: a fake 8-device CPU mesh.
+
+The reference tests distribution with a local-mode SparkContext
+(``test/conftest.py :: sc`` fixture, ``local[2]`` — SURVEY §4): same code
+paths, no cluster.  The analog here is 8 virtual CPU devices via
+``xla_force_host_platform_device_count``, so ``psum``/``all_to_all``/
+sharding semantics run for real without TPU hardware.
+
+x64 is enabled so dtypes match the NumPy oracle exactly (the reference is
+bit-compatible with numpy defaults; SURVEY §7 "decide early").
+"""
+
+import os
+
+# must be appended before the first backend initialisation
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+# the environment pins JAX_PLATFORMS to the TPU plugin at interpreter start;
+# tests always run on the virtual CPU mesh
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    """1-d 8-device mesh — the default distribution context."""
+    return jax.make_mesh((8,), ("k",))
+
+
+@pytest.fixture(scope="session")
+def mesh2d():
+    """2-d (4, 2) mesh for multi-axis key sharding."""
+    return jax.make_mesh((4, 2), ("a", "b"))

@@ -1,0 +1,55 @@
+"""Backend-agnostic assertion suites.
+
+Reference: ``test/generic.py`` — shared map/filter/reduce suites called from
+both local and distributed test files, enforcing cross-backend API
+equivalence (SURVEY §4).  Each suite takes a constructed bolt array plus the
+plain numpy original and asserts parity through ``toarray()``.
+"""
+
+from operator import add
+
+import numpy as np
+
+from bolt_tpu.utils import allclose
+
+
+def map_suite(x, b):
+    """``b`` is a bolt array built from ``x`` with ``axis=(0,)``."""
+    # identity
+    assert allclose(b.map(lambda v: v, axis=(0,)).toarray(), x)
+    # elementwise
+    assert allclose(b.map(lambda v: v * 2, axis=(0,)).toarray(), x * 2)
+    # value-shape-changing
+    expected = np.asarray([v.sum(axis=0) for v in x])
+    assert allclose(b.map(lambda v: v.sum(axis=0), axis=(0,)).toarray(), expected)
+    # multiple key axes
+    expected = x * 3
+    assert allclose(b.map(lambda v: v * 3, axis=(0, 1)).toarray(), expected)
+    # with_keys: add the first key component
+    mapped = b.map(lambda kv: kv[1] + kv[0][0], axis=(0,), with_keys=True)
+    expected = x + np.arange(x.shape[0]).reshape((-1,) + (1,) * (x.ndim - 1))
+    assert allclose(mapped.toarray(), expected)
+
+
+def filter_suite(x, b):
+    # keep blocks whose mean is positive
+    pred = lambda v: v.mean() > 0
+    expected = np.asarray([v for v in x if v.mean() > 0])
+    out = b.filter(pred, axis=(0,)).toarray()
+    assert allclose(out, expected)
+    # keep everything
+    assert allclose(b.filter(lambda v: True, axis=(0,)).toarray(), x)
+    # drop everything → shape (0, *value_shape)
+    empty = b.filter(lambda v: False, axis=(0,)).toarray()
+    assert empty.shape == (0,) + x.shape[1:]
+
+
+def reduce_suite(x, b):
+    assert allclose(b.reduce(add, axis=(0,)).toarray(), x.sum(axis=0))
+    mx = b.reduce(np.maximum, axis=(0,)).toarray()
+    assert allclose(mx, x.max(axis=0))
+    # multi-axis reduce
+    assert allclose(b.reduce(add, axis=(0, 1)).toarray(), x.sum(axis=(0, 1)))
+    # keepdims
+    kd = b.reduce(add, axis=(0,), keepdims=True).toarray()
+    assert allclose(kd, x.sum(axis=0, keepdims=True))

@@ -1,0 +1,77 @@
+"""Local-backend (oracle) tests.
+
+Reference test area: ``test/test_local_basic.py`` (SURVEY §4).
+"""
+
+from operator import add
+
+import numpy as np
+
+import bolt_tpu as bolt
+from bolt_tpu.local.array import BoltArrayLocal
+from bolt_tpu.utils import allclose
+
+from tests.generic import filter_suite, map_suite, reduce_suite
+
+
+def _x():
+    rs = np.random.RandomState(0)
+    return rs.randn(6, 4, 5)
+
+
+def test_construct_and_props():
+    x = _x()
+    b = bolt.array(x)
+    assert isinstance(b, BoltArrayLocal)
+    assert b.mode == "local"
+    assert b.shape == x.shape
+    assert b.dtype == x.dtype
+    assert allclose(b.toarray(), x)
+
+
+def test_numpy_inheritance():
+    x = _x()
+    b = bolt.array(x)
+    # the local backend inherits the full numpy surface
+    assert allclose((b + 1), x + 1)
+    assert allclose(b.mean(axis=(0, 1)), x.mean(axis=(0, 1)))
+    assert allclose(b.std(axis=0), x.std(axis=0))
+    assert allclose(b.T, x.T)
+
+
+def test_map():
+    x = _x()
+    map_suite(x, bolt.array(x))
+
+
+def test_filter():
+    x = _x()
+    filter_suite(x, bolt.array(x))
+
+
+def test_reduce():
+    x = _x()
+    reduce_suite(x, bolt.array(x))
+
+
+def test_map_nonleading_axis():
+    x = _x()
+    b = bolt.array(x)
+    # mapping over axis 1: keys become axis 1, result key-leading
+    out = b.map(lambda v: v.sum(), axis=(1,)).toarray()
+    expected = np.asarray([x[:, i, :].sum() for i in range(x.shape[1])])
+    assert allclose(out, expected)
+
+
+def test_first_concatenate():
+    x = _x()
+    b = bolt.array(x)
+    assert allclose(b.first(), x[0])
+    c = b.concatenate(x, axis=0)
+    assert allclose(c.toarray(), np.concatenate([x, x], axis=0))
+
+
+def test_repr():
+    b = bolt.array(_x())
+    r = repr(b)
+    assert "local" in r and "shape" in r

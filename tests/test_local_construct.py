@@ -1,0 +1,41 @@
+"""Local constructor tests (reference area: ``test/test_local_construct.py``,
+SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.local.array import BoltArrayLocal
+from bolt_tpu.utils import allclose
+
+
+def test_array():
+    x = np.arange(12).reshape(3, 4)
+    b = bolt.array(x)
+    assert isinstance(b, BoltArrayLocal)
+    assert allclose(b.toarray(), x)
+    b = bolt.array(x, dtype=np.float32)
+    assert b.dtype == np.float32
+
+
+def test_ones_zeros():
+    assert allclose(bolt.ones((2, 3)).toarray(), np.ones((2, 3)))
+    assert allclose(bolt.zeros((2, 3)).toarray(), np.zeros((2, 3)))
+    assert bolt.ones((2, 3)).dtype == np.ones((2, 3)).dtype
+    assert bolt.ones((2, 3), dtype=np.int32).dtype == np.int32
+
+
+def test_concatenate():
+    x = np.arange(6).reshape(2, 3)
+    out = bolt.concatenate((x, x), axis=1)
+    assert allclose(out.toarray(), np.concatenate((x, x), axis=1))
+    with pytest.raises(ValueError):
+        bolt.concatenate([], axis=0)
+
+
+def test_mode_dispatch():
+    x = np.arange(4.0)
+    assert bolt.array(x).mode == "local"
+    assert bolt.array(x, mode="local").mode == "local"
+    with pytest.raises(ValueError):
+        bolt.array(x, mode="nope")

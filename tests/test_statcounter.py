@@ -1,0 +1,63 @@
+"""Unit tests for the mergeable StatCounter (reference:
+``bolt/spark/statcounter.py`` unit coverage, SURVEY §4)."""
+
+import numpy as np
+
+from bolt_tpu.statcounter import StatCounter
+from bolt_tpu.utils import allclose
+
+
+def _x():
+    rs = np.random.RandomState(7)
+    return rs.randn(20, 4)
+
+
+def test_merge_stream():
+    x = _x()
+    c = StatCounter(values=list(x))
+    assert c.count() == 20
+    assert allclose(c.mean(), x.mean(axis=0))
+    assert allclose(c.variance(), x.var(axis=0))
+    assert allclose(c.stdev(), x.std(axis=0))
+    assert allclose(c.max(), x.max(axis=0))
+    assert allclose(c.min(), x.min(axis=0))
+
+
+def test_merge_stats_parallel():
+    x = _x()
+    # split into 3 uneven partitions, combine pairwise (Chan)
+    parts = [x[:3], x[3:11], x[11:]]
+    counters = [StatCounter(values=list(p)) for p in parts]
+    total = counters[0].mergeStats(counters[1]).mergeStats(counters[2])
+    assert total.count() == 20
+    assert allclose(total.mean(), x.mean(axis=0))
+    assert allclose(total.variance(), x.var(axis=0))
+
+
+def test_merge_empty():
+    x = _x()
+    a = StatCounter()
+    b = StatCounter(values=list(x))
+    a.mergeStats(b)
+    assert a.count() == 20
+    assert allclose(a.mean(), x.mean(axis=0))
+    b.mergeStats(StatCounter())
+    assert b.count() == 20
+
+
+def test_requested_subset():
+    x = _x()
+    c = StatCounter(values=list(x), stats=("mean",))
+    assert allclose(c.mean(), x.mean(axis=0))
+
+
+def test_sample_variance():
+    x = _x()
+    c = StatCounter(values=list(x))
+    assert allclose(c.sampleVariance(), x.var(axis=0, ddof=1))
+    assert allclose(c.sampleStdev(), x.std(axis=0, ddof=1))
+
+
+def test_repr():
+    c = StatCounter(values=[1.0, 2.0, 3.0])
+    assert "count: 3" in repr(c)

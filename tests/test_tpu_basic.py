@@ -1,0 +1,64 @@
+"""TPU-backend basics: dtype, astype, cache, repr, ufunc-via-map parity
+(reference area: ``test/test_spark_basic.py``, SURVEY §4)."""
+
+import numpy as np
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+
+def _x():
+    rs = np.random.RandomState(2)
+    return rs.randn(8, 4, 5)
+
+
+def test_dtype_preserved(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    assert b.dtype == np.float64
+    assert b.toarray().dtype == np.float64
+    b32 = bolt.array(x.astype(np.float32), mesh)
+    assert b32.dtype == np.float32
+
+
+def test_astype(mesh):
+    x = np.arange(16.0).reshape(8, 2)
+    b = bolt.array(x, mesh)
+    c = b.astype(np.int32)
+    assert c.dtype == np.int32
+    assert allclose(c.toarray(), x.astype(np.int32))
+
+
+def test_ufunc_via_map(mesh):
+    x = np.abs(_x()) + 0.5
+    b = bolt.array(x, mesh)
+    for f in (np.sqrt, np.log, np.exp, np.sin):
+        assert allclose(b.map(f).toarray(), f(x))
+
+
+def test_cache_unpersist_repartition(mesh):
+    b = bolt.ones((8, 3), mesh)
+    assert b.cache() is b
+    assert b.unpersist() is b
+    assert b.repartition(4) is b
+
+
+def test_first(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    assert allclose(b.first(), x[0])
+    b2 = bolt.array(x, mesh, axis=(0, 1))
+    assert allclose(b2.first(), x[0, 0])
+
+
+def test_repr(mesh):
+    b = bolt.ones((8, 3), mesh)
+    r = repr(b)
+    assert "tpu" in r and "split: 1" in r and "shape" in r
+
+
+def test_size_ndim(mesh):
+    b = bolt.ones((8, 3, 2), mesh)
+    assert b.size == 48
+    assert b.ndim == 3
+    assert np.asarray(b).shape == (8, 3, 2)

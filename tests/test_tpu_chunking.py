@@ -1,0 +1,218 @@
+"""TPU-backend chunking: plans, padding, per-chunk map, axis exchange
+(reference area: ``test/test_spark_chunking.py``, SURVEY §4; BASELINE
+config 5)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose, prod
+
+
+def _x(shape=(8, 6, 4)):
+    rs = np.random.RandomState(9)
+    return rs.randn(*shape)
+
+
+def test_chunk_is_a_view(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(2,), axis=(0,))
+    assert c.plan == (2, 4)
+    assert c.padding == (0, 0)
+    assert c.kshape == (8,)
+    assert c.vshape == (6, 4)
+    assert c.grid == (3, 1)
+    assert c.uniform
+    # unchunk is a no-op unwrap
+    assert c.unchunk() is b
+
+
+def test_chunk_mb_budget(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    # 64 bytes budget: 6*4*8B = 192B blocks must be split down
+    c = b.chunk(size=str(64 / 1e6))
+    block_bytes = prod(c.plan) * b.dtype.itemsize
+    assert block_bytes <= 64 or all(p == 1 for p in c.plan)
+    # default budget is huge relative to this array: one chunk
+    assert bolt.array(x, mesh).chunk().plan == (6, 4)
+
+
+def test_chunk_validation(mesh):
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError):
+        b.chunk(size=(2,), axis=(5,))
+    with pytest.raises(ValueError):
+        b.chunk(size=(0,), axis=(0,))
+    with pytest.raises(ValueError):
+        b.chunk(size=(2,), axis=(0,), padding=2)  # padding >= chunk
+
+
+def test_map_uniform(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(3, 2), axis=(0, 1))
+    out = c.map(lambda blk: blk * 2)
+    assert out.plan == (3, 2)
+    assert allclose(out.unchunk().toarray(), x * 2)
+
+
+def test_map_uniform_shape_changing(mesh):
+    # per-chunk gram matrix: (3, 4) block -> (4, 4); rank preserved
+    # (the shape-changing regime BASELINE config 5's per-chunk SVD needs)
+    x = _x((4, 6, 4))
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(3,), axis=(0,))
+    out = c.map(lambda blk: blk.T @ blk)
+    assert out.plan == (4, 4)
+    assert out.unchunk().shape == (4, 8, 4)
+    expected = np.concatenate(
+        [x[k, i * 3:(i + 1) * 3].T @ x[k, i * 3:(i + 1) * 3]
+         for k in range(4) for i in range(2)], axis=0).reshape(4, 8, 4)
+    assert allclose(out.unchunk().toarray(), expected)
+
+
+def test_map_ragged(mesh):
+    x = _x((8, 5, 4))
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(2,), axis=(0,))  # 5 = 2+2+1 ragged
+    assert not c.uniform
+    out = c.map(lambda blk: blk * 2 + 1)
+    assert allclose(out.unchunk().toarray(), x * 2 + 1)
+
+
+def test_map_padding_trim(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(2,), axis=(0,), padding=1)
+    assert c.padding == (1, 0)
+    # elementwise func: halo is trimmed away, result identical to unpadded
+    out = c.map(lambda blk: blk * 3)
+    assert allclose(out.unchunk().toarray(), x * 3)
+
+
+def test_map_padding_halo_visible(mesh):
+    # a halo-dependent, shape-preserving func: per-block max broadcast.
+    # interior blocks see neighbours through the halo.
+    x = np.zeros((1, 6))
+    x[0, 3] = 10.0  # lives in block 1 (cols 2:4)
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(2,), axis=(0,), padding=1)
+    out = c.map(lambda blk: blk * 0 + blk.max()).unchunk().toarray()
+    # block 0 covers cols 0:2, halo reaches col 2 (value 0) — but block 1's
+    # halo spans cols 1:5 so sees the 10; block 2 (cols 4:6) halo sees col 3
+    assert out[0, 2] == 10.0 and out[0, 3] == 10.0  # block 1 core
+    assert out[0, 4] == 10.0  # block 2 saw the 10 through its halo
+    assert out[0, 0] == 0.0   # block 0 never saw it
+
+
+def test_map_padding_requires_shape_preserving(mesh):
+    b = bolt.array(_x(), mesh)
+    c = b.chunk(size=(2,), axis=(0,), padding=1)
+    with pytest.raises(ValueError):
+        c.map(lambda blk: blk[:1])
+
+
+def test_per_chunk_svd_config5(mesh):
+    # BASELINE config 5: tall-skinny PCA — per-chunk SVD of (N, features)
+    import jax.numpy as jnp
+    x = _x((4, 20, 3))
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(10,), axis=(0,))
+    # singular values per (10, 3) chunk -> rank-preserving (1, 3) block
+    out = c.map(lambda blk: jnp.linalg.svd(blk, compute_uv=False)[None, :])
+    assert out.unchunk().shape == (4, 2, 3)
+    expected = np.stack([
+        np.stack([np.linalg.svd(x[k, i * 10:(i + 1) * 10], compute_uv=False)
+                  for i in range(2)]) for k in range(4)])
+    assert allclose(out.unchunk().toarray(), expected)
+
+
+def test_keys_to_values(mesh):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))  # keys (8, 6), values (4,)
+    c = b.chunk(size=(2,), axis=(0,))
+    k2v = c.keys_to_values((1,))
+    # key axis 1 (size 6) moved to the front of the values
+    assert k2v.kshape == (8,)
+    assert k2v.vshape == (6, 4)
+    assert k2v.plan == (6, 2)
+    assert allclose(k2v.unchunk().toarray(), x)
+    # with an explicit chunk size for the moved axis
+    k2v = c.keys_to_values((1,), size=(3,))
+    assert k2v.plan == (3, 2)
+
+
+def test_values_to_keys(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)  # keys (8,), values (6, 4)
+    c = b.chunk(size=(2, 2), axis=(0, 1))
+    v2k = c.values_to_keys((0,))
+    # value axis 0 (size 6) appended to the keys
+    assert v2k.kshape == (8, 6)
+    assert v2k.vshape == (4,)
+    assert v2k.plan == (2,)
+    assert allclose(v2k.unchunk().toarray(), np.transpose(x, (0, 1, 2)))
+    with pytest.raises(ValueError):
+        c.values_to_keys((9,))
+
+
+def test_keys_to_values_unsorted_order(mesh):
+    # axes move in the order GIVEN; the plan must track that order
+    x = _x((4, 2, 3, 5))
+    b = bolt.array(x, mesh, axis=(0, 1, 2))  # keys (4,2,3), values (5,)
+    c = b.chunk(size=(5,), axis=(0,))
+    k2v = c.keys_to_values((2, 1))
+    assert k2v.kshape == (4,)
+    assert k2v.vshape == (3, 2, 5)
+    assert k2v.plan == (3, 2, 5)
+    assert k2v.uniform
+    assert allclose(k2v.unchunk().toarray(), np.transpose(x, (0, 2, 1, 3)))
+
+
+def test_keys_to_values_all_keys(mesh):
+    # moving every key axis is legal on the chunk primitives (split=0
+    # intermediate); values_to_keys restores keys
+    x = _x((4, 6, 5))
+    b = bolt.array(x, mesh, axis=(0,))
+    c = b.chunk(size=(3,), axis=(0,))
+    k2v = c.keys_to_values((0,))
+    assert k2v.split == 0
+    assert k2v.vshape == (4, 6, 5)
+    restored = k2v.values_to_keys((0,))
+    assert restored.split == 1
+    assert allclose(restored.unchunk().toarray(), x)
+    with pytest.raises(ValueError):
+        c.keys_to_values((3,))
+
+
+def test_keys_reshape_trailing_one(mesh):
+    # the keys view states the boundary explicitly: a trailing size-1 key
+    # axis stays a KEY axis
+    x = _x((4, 3))
+    b = bolt.array(np.ones((4, 3)), mesh)
+    out = b.keys.reshape(4, 1)
+    assert out.shape == (4, 1, 3)
+    assert out.split == 2
+    out = b.values.reshape(3, 1)
+    assert out.shape == (4, 3, 1)
+    assert out.split == 1
+
+
+def test_swap_equivalence_via_chunk(mesh):
+    # swap == chunk → keys_to_values → values_to_keys → unchunk
+    # (the reference's own decomposition, SURVEY §3.3)
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    direct = b.swap((0,), (0,))
+    via_chunk = b.chunk().keys_to_values((0,)).values_to_keys((1,)).unchunk()
+    assert direct.shape == via_chunk.shape
+    assert direct.split == via_chunk.split
+    assert allclose(direct.toarray(), via_chunk.toarray())
+
+
+def test_repr(mesh):
+    c = bolt.array(_x(), mesh).chunk(size=(2,), axis=(0,))
+    r = repr(c)
+    assert "plan" in r and "grid" in r and "padding" in r

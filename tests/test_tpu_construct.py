@@ -1,0 +1,96 @@
+"""TPU-backend construction tests (reference area:
+``test/test_spark_construct.py``, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.tpu.array import BoltArrayTPU
+from bolt_tpu.utils import allclose
+
+
+def _x():
+    rs = np.random.RandomState(1)
+    return rs.randn(8, 4, 5)
+
+
+def test_array_dispatch(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    assert isinstance(b, BoltArrayTPU)
+    assert b.mode == "tpu"
+    assert b.shape == x.shape
+    assert b.split == 1
+    assert allclose(b.toarray(), x)
+    # keyword context
+    assert bolt.array(x, context=mesh).mode == "tpu"
+    # explicit mode with default mesh
+    assert bolt.array(x, mode="tpu").mode == "tpu"
+
+
+def test_array_axis(mesh):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    assert b.split == 2
+    assert allclose(b.toarray(), x)
+    # non-leading key axis: moved to the front of the logical shape
+    b = bolt.array(x, mesh, axis=(1,))
+    assert b.shape == (4, 8, 5)
+    assert allclose(b.toarray(), np.transpose(x, (1, 0, 2)))
+
+
+def test_array_sharded(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    # the key axis (8) divides the mesh (8): one shard per device
+    assert len(b._data.sharding.device_set) == 8
+
+
+def test_ones_zeros(mesh):
+    b = bolt.ones((8, 3, 2), mesh)
+    assert allclose(b.toarray(), np.ones((8, 3, 2)))
+    assert b.dtype == np.float64
+    b = bolt.zeros((8, 3), mesh, dtype=np.float32)
+    assert allclose(b.toarray(), np.zeros((8, 3)))
+    assert b.dtype == np.float32
+    # built directly sharded on device
+    assert len(b._data.sharding.device_set) == 8
+
+
+def test_ones_axis(mesh):
+    b = bolt.ones((3, 8), mesh, axis=(1,))
+    assert b.shape == (8, 3)
+    assert b.split == 1
+
+
+def test_indivisible_key_axis(mesh):
+    # 7 does not divide 8: replicated but still correct
+    x = np.arange(7.0 * 3).reshape(7, 3)
+    b = bolt.array(x, mesh)
+    assert allclose(b.toarray(), x)
+    assert allclose(b.map(lambda v: v * 2).toarray(), x * 2)
+
+
+def test_concatenate(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = bolt.concatenate((b, b), axis=1)
+    assert isinstance(out, BoltArrayTPU)
+    assert allclose(out.toarray(), np.concatenate([x, x], axis=1))
+
+
+def test_context_validation():
+    with pytest.raises(ValueError):
+        bolt.array(np.ones(3), context="not a mesh", mode="tpu")
+
+
+def test_conversions(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    loc = b.tolocal()
+    assert loc.mode == "local"
+    assert allclose(loc.toarray(), x)
+    back = loc.totpu(mesh)
+    assert back.mode == "tpu"
+    assert allclose(back.toarray(), x)
+    assert b.totpu() is b

@@ -1,0 +1,102 @@
+"""TPU-backend map/filter/reduce, including non-aligned axes that force an
+``_align`` swap (reference area: ``test/test_spark_functional.py``,
+SURVEY §4)."""
+
+from operator import add
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+from tests.generic import filter_suite, map_suite, reduce_suite
+
+
+def _x():
+    rs = np.random.RandomState(3)
+    return rs.randn(8, 4, 5)
+
+
+def test_map(mesh):
+    map_suite(_x(), bolt.array(_x(), mesh))
+
+
+def test_filter(mesh):
+    filter_suite(_x(), bolt.array(_x(), mesh))
+
+
+def test_reduce(mesh):
+    reduce_suite(_x(), bolt.array(_x(), mesh))
+
+
+def test_map_nonaligned_axis(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)  # keys = (0,)
+    # mapping over axis 1 forces an implicit swap (reference _align)
+    out = b.map(lambda v: v.sum(), axis=(1,))
+    assert out.split == 1
+    expected = np.asarray([x[:, i, :].sum() for i in range(x.shape[1])])
+    assert allclose(out.toarray(), expected)
+
+
+def test_map_value_axis_pair(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.map(lambda v: v * 2, axis=(0, 2))
+    # result keys = (axis0, axis2) leading
+    expected = np.transpose(x, (0, 2, 1)) * 2
+    assert allclose(out.toarray(), expected)
+
+
+def test_map_value_shape_check(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.map(lambda v: v.sum(axis=0), value_shape=(5,))
+    assert allclose(out.toarray(), np.asarray([v.sum(axis=0) for v in x]))
+    with pytest.raises(ValueError):
+        b.map(lambda v: v.sum(axis=0), value_shape=(3,))
+
+
+def test_map_dtype_arg(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.map(lambda v: v, dtype=np.float32)
+    assert out.dtype == np.float32
+
+
+def test_map_nontraceable_fallback(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+
+    def hostile(v):
+        # .item() and float() force concrete values: not jax-traceable
+        return np.full((2,), float(np.asarray(v).sum()))
+
+    out = b.map(hostile)
+    expected = np.asarray([hostile(v) for v in x])
+    assert allclose(out.toarray(), expected)
+
+
+def test_filter_on_value_axis(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.filter(lambda v: v[0, 0] > 0, axis=(1,))
+    expected = np.asarray([x[:, i, :] for i in range(x.shape[1])
+                           if x[0, i, 0] > 0])
+    assert allclose(out.toarray(), expected)
+    assert out.split == 1
+
+
+def test_reduce_errors(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    with pytest.raises(ValueError):
+        # shape-changing reducer is invalid
+        b.reduce(lambda a, c: (a + c)[:2])
+
+
+def test_reduce_single_record(mesh):
+    x = np.ones((1, 3))
+    b = bolt.array(x, mesh)
+    assert allclose(b.reduce(add).toarray(), x.sum(axis=0))

@@ -1,0 +1,86 @@
+"""TPU-backend indexing vs numpy (reference area:
+``test/test_spark_getting.py``, SURVEY §4; BASELINE config 4 exercises the
+boolean-mask path via filter)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+
+def _x():
+    rs = np.random.RandomState(6)
+    return rs.randn(8, 4, 5)
+
+
+def test_slices(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    assert allclose(b[:].toarray(), x)
+    assert allclose(b[2:6].toarray(), x[2:6])
+    assert allclose(b[:, 1:3].toarray(), x[:, 1:3])
+    assert allclose(b[::2, :, ::2].toarray(), x[::2, :, ::2])
+    assert allclose(b[1:7:2, ::-1].toarray(), x[1:7:2, ::-1])
+
+
+def test_ints_squeeze(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b[3]
+    assert out.shape == x[3].shape
+    assert out.split == 0
+    assert allclose(out.toarray(), x[3])
+    out = b[:, 2]
+    assert out.split == 1
+    assert allclose(out.toarray(), x[:, 2])
+    assert allclose(b[-1, -2, -3].toarray(), np.asarray(x[-1, -2, -3]))
+
+
+def test_lists_orthogonal(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    assert allclose(b[[0, 3, 5]].toarray(), x[[0, 3, 5]])
+    # per-axis advanced indices apply orthogonally (np.ix_ semantics)
+    out = b[[0, 1], :, [0, 2, 4]]
+    expected = x[np.ix_([0, 1], range(4), [0, 2, 4])]
+    assert allclose(out.toarray(), expected)
+    assert allclose(b[:, [3, 1]].toarray(), x[:, [3, 1]])
+    assert allclose(b[[-1, 0]].toarray(), x[[-1, 0]])
+
+
+def test_bool_arrays(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    kmask = x[:, 0, 0] > 0
+    assert allclose(b[kmask].toarray(), x[kmask])
+    vmask = np.array([True, False, True, False, True])
+    assert allclose(b[:, :, vmask].toarray(), x[:, :, vmask])
+
+
+def test_mixed(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b[2:7, [0, 3], ::2]
+    expected = x[2:7][:, [0, 3]][:, :, ::2]
+    assert allclose(out.toarray(), expected)
+    out = b[1, :, [0, 4]]
+    expected = x[1][:, [0, 4]]
+    assert allclose(out.toarray(), expected)
+
+
+def test_split_bookkeeping(mesh):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    assert b[0].split == 1
+    assert b[0, 0].split == 0
+    assert b[:, 0].split == 1
+    assert b[:, :, 0].split == 2
+
+
+def test_errors(mesh):
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError):
+        b[0, 0, 0, 0]
+    with pytest.raises(IndexError):
+        b[99]

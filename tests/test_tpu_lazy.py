@@ -1,0 +1,87 @@
+"""Deferred-map (lazy chain) semantics: the TPU analog of the reference's
+lazy RDD transformations — transformations defer, actions fuse and execute
+(reference behavior: ``BoltArraySpark`` ops build RDD lineage; a job runs
+only on actions like ``collect``/``reduce``/``aggregate``, SURVEY §3)."""
+
+import numpy as np
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+
+def _x():
+    rs = np.random.RandomState(11)
+    return rs.randn(8, 4, 5)
+
+
+def test_map_is_deferred(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    m = b.map(lambda v: v + 1)
+    assert m.deferred
+    assert m.shape == x.shape          # shape known without executing
+    assert m.dtype == x.dtype
+    assert "deferred" in repr(m)
+    # action materialises
+    assert allclose(m.toarray(), x + 1)
+    assert not m.deferred
+
+
+def test_chain_fuses(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    m = b.map(lambda v: v + 1).map(lambda v: v * 2).map(lambda v: v - 3)
+    assert m.deferred
+    assert len(m._chain[1]) == 3
+    assert allclose(m.toarray(), (x + 1) * 2 - 3)
+
+
+def test_reduce_consumes_chain(mesh):
+    from operator import add
+    x = _x()
+    b = bolt.array(x, mesh)
+    m = b.map(lambda v: v + 1)
+    r = m.reduce(add)
+    assert m.deferred                   # reduce fused; map never materialised
+    assert allclose(r.toarray(), (x + 1).sum(axis=0))
+
+
+def test_stats_consume_chain(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v * 2)
+    out = m.sum()
+    assert m.deferred
+    assert allclose(out.toarray(), (x * 2).sum(axis=0))
+    assert allclose(m.mean(axis=(0, 1)).toarray(), (x * 2).mean(axis=(0, 1)))
+    assert m.deferred
+
+
+def test_cache_forces(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v + 1)
+    assert m.deferred
+    m.cache()
+    assert not m.deferred
+    assert allclose(m.toarray(), x + 1)
+
+
+def test_astype_defers_and_fuses(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v + 1).astype(np.float32)
+    assert m.deferred
+    assert m.dtype == np.float32
+    assert allclose(m.toarray(), (x + 1).astype(np.float32))
+
+
+def test_swap_materialises(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v + 1)
+    s = m.swap((0,), (0,))
+    assert not s.deferred
+    assert allclose(s.toarray(), np.transpose(x + 1, (1, 0, 2)))
+
+
+def test_with_keys_map_is_eager(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda kv: kv[1] + kv[0][0], with_keys=True)
+    assert not m.deferred

@@ -1,0 +1,61 @@
+"""TPU-backend stacking (reference area: ``test/test_spark_stacking.py``,
+SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+
+def _x():
+    rs = np.random.RandomState(10)
+    return rs.randn(8, 4, 5)
+
+
+def test_stack_view(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    s = b.stacked(size=3)
+    assert s.shape == x.shape
+    assert s.split == 1
+    assert s.size == 3
+    assert s.nblocks == 3  # 8 records in blocks of 3 -> 3, 3, 2
+    assert s.unstack() is b
+    with pytest.raises(ValueError):
+        b.stacked(size=0)
+
+
+def test_stack_map_elementwise(mesh):
+    x = _x()
+    out = bolt.array(x, mesh).stacked(size=3).map(lambda blk: blk * 2)
+    assert allclose(out.unstack().toarray(), x * 2)
+
+
+def test_stack_map_blockwise(mesh):
+    # a genuinely block-level func: normalise within each stack block
+    x = _x()
+    s = bolt.array(x, mesh).stacked(size=4)
+    out = s.map(lambda blk: blk - blk.mean(axis=0)).unstack().toarray()
+    expected = np.concatenate(
+        [x[i:i + 4] - x[i:i + 4].mean(axis=0) for i in (0, 4)])
+    assert allclose(out, expected)
+
+
+def test_stack_map_value_shape_change(mesh):
+    x = _x()
+    out = (bolt.array(x, mesh).stacked(size=5)
+           .map(lambda blk: blk.sum(axis=2)).unstack())
+    assert out.shape == (8, 4)
+    assert allclose(out.toarray(), x.sum(axis=2))
+
+
+def test_stack_map_count_guard(mesh):
+    s = bolt.array(_x(), mesh).stacked(size=4)
+    with pytest.raises(ValueError):
+        s.map(lambda blk: blk[:2])
+
+
+def test_repr(mesh):
+    r = repr(bolt.array(_x(), mesh).stacked(size=3))
+    assert "nblocks: 3" in r and "size: 3" in r

@@ -1,0 +1,87 @@
+"""TPU-backend statistics: jnp-lowered mean/var/std/sum/max/min and the
+explicit shard_map Welford path (reference area: StatCounter aggregation in
+``test/test_spark_basic.py``/functional tests, SURVEY §4; BASELINE config 2).
+"""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+
+def _x():
+    rs = np.random.RandomState(4)
+    return rs.randn(8, 4, 5)
+
+
+@pytest.mark.parametrize("name", ["mean", "var", "std", "sum", "max", "min"])
+def test_stats_default_axis(mesh, name):
+    x = _x()
+    b = bolt.array(x, mesh)
+    got = getattr(b, name)().toarray()
+    expected = getattr(x, name)(axis=0)
+    assert allclose(got, expected)
+
+
+@pytest.mark.parametrize("name", ["mean", "var", "std", "sum", "max", "min"])
+@pytest.mark.parametrize("axis", [(0,), (0, 1), (1, 2), (2,), None])
+def test_stats_axes(mesh, name, axis):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    got = getattr(b, name)(axis=axis).toarray()
+    np_axis = axis if axis is not None else (0, 1)  # default: all key axes
+    expected = np.asarray(getattr(x, name)(axis=np_axis))
+    assert allclose(got, expected)
+
+
+def test_stats_keepdims(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.mean(axis=(0,), keepdims=True)
+    assert out.split == 1
+    assert allclose(out.toarray(), x.mean(axis=0, keepdims=True))
+
+
+def test_stats_split_bookkeeping(mesh):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    assert b.sum(axis=(0,)).split == 1
+    assert b.sum(axis=(0, 1)).split == 0
+    assert b.sum(axis=(2,)).split == 2
+
+
+def test_welford_stats(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    counter = b.stats()
+    assert counter.count() == 8
+    assert allclose(counter.mean(), x.mean(axis=0))
+    assert allclose(counter.variance(), x.var(axis=0))
+    assert allclose(counter.stdev(), x.std(axis=0))
+    assert allclose(counter.max(), x.max(axis=0))
+    assert allclose(counter.min(), x.min(axis=0))
+
+
+def test_welford_partial_axis(mesh):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    counter = b.stats(axis=(1,))
+    assert counter.count() == 4
+    assert allclose(counter.mean(), x.mean(axis=1))
+    assert allclose(counter.variance(), x.var(axis=1))
+
+
+def test_welford_rejects_value_axis(mesh):
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError):
+        b.stats(axis=(1,))
+
+
+def test_sum_bit_exact_integral(mesh):
+    # integral floats: sum is bit-exact regardless of reduction order
+    # (BASELINE north-star parity condition for config 1)
+    x = np.arange(8.0 * 6).reshape(8, 6)
+    b = bolt.array(x, mesh)
+    assert allclose(b.sum().toarray(), x.sum(axis=0))
+    assert float(b.sum(axis=(0, 1)).toarray()) == float(x.sum())

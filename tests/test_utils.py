@@ -1,0 +1,72 @@
+"""Unit tests for ``bolt_tpu/utils.py`` (reference test area:
+``test/test_utils``-style direct unit coverage, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from bolt_tpu.utils import (allclose, argpack, inshape, isreshapeable,
+                            istransposeable, iterexpand, listify, prod,
+                            slicify, tupleize)
+
+
+def test_tupleize():
+    assert tupleize(1) == (1,)
+    assert tupleize((1, 2)) == (1, 2)
+    assert tupleize([1, 2]) == (1, 2)
+    assert tupleize(range(3)) == (0, 1, 2)
+    assert tupleize(((1, 2),)) == (1, 2)
+    assert tupleize(None) is None
+
+
+def test_listify():
+    assert listify(1) == [1]
+    assert listify((1, 2)) == [1, 2]
+
+
+def test_argpack():
+    assert argpack((1, 2, 3)) == (1, 2, 3)
+    assert argpack(((1, 2, 3),)) == (1, 2, 3)
+    assert argpack(([1, 2],)) == (1, 2)
+
+
+def test_inshape():
+    inshape((2, 3, 4), (0, 2))
+    with pytest.raises(ValueError):
+        inshape((2, 3), (2,))
+    with pytest.raises(ValueError):
+        inshape((2, 3), (-1,))
+
+
+def test_iterexpand():
+    assert iterexpand(2, 3) == (2, 2, 2)
+    assert iterexpand((1, 2), 2) == (1, 2)
+    with pytest.raises(ValueError):
+        iterexpand((1, 2), 3)
+
+
+def test_slicify():
+    assert slicify(slice(None), 5) == slice(0, 5, 1)
+    assert slicify(slice(1, None), 5) == slice(1, 5, 1)
+    assert slicify(2, 5) == slice(2, 3, 1)
+    assert slicify(-1, 5) == slice(4, 5, 1)
+    assert list(slicify([1, -1], 5)) == [1, 4]
+    assert list(slicify(np.array([True, False, True]), 3)) == [0, 2]
+    with pytest.raises(IndexError):
+        slicify(5, 5)
+    with pytest.raises(IndexError):
+        slicify([5], 5)
+
+
+def test_transposeable_reshapeable():
+    assert istransposeable((1, 0), (0, 1))
+    assert not istransposeable((0, 2), (0, 1))
+    assert isreshapeable((6,), (2, 3))
+    assert not isreshapeable((7,), (2, 3))
+
+
+def test_allclose_and_prod():
+    assert allclose(np.ones(3), np.ones(3))
+    assert not allclose(np.ones(3), np.ones(4))
+    assert not allclose(np.ones(3), np.zeros(3))
+    assert prod((2, 3, 4)) == 24
+    assert prod(()) == 1
