@@ -110,6 +110,26 @@ class BoltArrayLocal(np.ndarray, BoltArray):
             out = out.reshape((1,) * len(key_shape) + value_shape)
         return BoltArrayLocal(out)
 
+    def stats(self, requested=("mean", "var", "std", "min", "max"), axis=None):
+        """Moment statistics over key axes, returned as a
+        :class:`~bolt_tpu.statcounter.StatCounter` — the same contract the
+        TPU backend serves via its shard_map Welford combine (reference:
+        ``BoltArraySpark.stats`` via ``rdd.aggregate(StatCounter)``).
+
+        ``axis=None`` means the leading axis, this backend's default key
+        axis."""
+        from bolt_tpu.statcounter import StatCounter
+        axes = (0,) if axis is None else tuple(sorted(tupleize(axis)))
+        inshape(self.shape, axes)
+        x = np.asarray(self)
+        n = prod(tuple(self.shape[a] for a in axes))
+        mu = x.mean(axis=axes, keepdims=True)
+        m2 = ((x - mu) ** 2).sum(axis=axes)
+        return StatCounter.from_moments(
+            n, np.squeeze(mu, axis=axes), m2,
+            minValue=x.min(axis=axes), maxValue=x.max(axis=axes),
+            stats=requested)
+
     # ------------------------------------------------------------------
     # conversions
     # ------------------------------------------------------------------

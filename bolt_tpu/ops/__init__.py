@@ -1,0 +1,3 @@
+from bolt_tpu.ops.kernels import fused_map_reduce, fused_stats
+
+__all__ = ["fused_map_reduce", "fused_stats"]

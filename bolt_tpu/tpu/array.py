@@ -56,6 +56,9 @@ from bolt_tpu.utils import argpack, inshape, isreshapeable, istransposeable, pro
 _JIT_CACHE = OrderedDict()
 _JIT_CACHE_MAX = 512
 
+# stable callables for scalar operator operands (see _scalar_fn)
+_SCALAR_FN_CACHE = OrderedDict()
+
 
 def _cached_jit(key, builder):
     fn = _JIT_CACHE.get(key)
@@ -476,6 +479,148 @@ class BoltArrayTPU(BoltArray):
         ``bolt_tpu/tpu/stats.py :: welford``."""
         from bolt_tpu.tpu.stats import welford
         return welford(self, requested=requested, axis=axis)
+
+    # ------------------------------------------------------------------
+    # elementwise operators
+    #
+    # The reference's Spark array has NO operator overloads — elementwise
+    # math goes through ``map`` (SURVEY §2.2) and only the local ndarray
+    # subclass gets them from numpy.  Providing them here is a deliberate
+    # superset: the same expressions now run on both backends.  Scalar
+    # operands defer (fuse into the map chain); array operands broadcast
+    # against the full logical shape in one compiled program.
+    # ------------------------------------------------------------------
+
+    # numpy must defer to the reflected operators below instead of
+    # consuming the distributed array via __array__ (which would silently
+    # gather it to host)
+    __array_ufunc__ = None
+
+    def _scalar_fn(self, op, other, reverse):
+        """A per-(op, scalar) callable with a STABLE identity, so deferred
+        chains built from repeated scalar expressions hit the jit cache
+        instead of recompiling per fresh lambda."""
+        key = (op.__name__, other, reverse)
+        fn = _SCALAR_FN_CACHE.get(key)
+        if fn is None:
+            if reverse:
+                def fn(v, _op=op, _o=other):
+                    return _op(_o, v)
+            else:
+                def fn(v, _op=op, _o=other):
+                    return _op(v, _o)
+            _SCALAR_FN_CACHE[key] = fn
+            if len(_SCALAR_FN_CACHE) > _JIT_CACHE_MAX:
+                _SCALAR_FN_CACHE.popitem(last=False)
+        else:
+            _SCALAR_FN_CACHE.move_to_end(key)
+        return fn
+
+    def _elementwise(self, other, op, reverse=False):
+        opname = op.__name__
+        if isinstance(other, (int, float, complex, np.number)):
+            fn = self._scalar_fn(op, other, reverse)
+            if self._split == 0:
+                out = _cached_jit(
+                    ("ew0", opname, other, self.shape, str(self.dtype),
+                     reverse, self._mesh),
+                    lambda: jax.jit(fn))(self._data)
+                return self._wrap(out, 0)
+            return self.map(fn, axis=tuple(range(self._split)))
+        if isinstance(other, BoltArrayTPU):
+            odata = other._data
+        elif isinstance(other, BoltArray):
+            odata = jnp.asarray(other.toarray())
+        else:
+            odata = jnp.asarray(np.asarray(other))
+        if np.broadcast_shapes(self.shape, odata.shape) != self.shape:
+            raise ValueError(
+                "operand of shape %s does not broadcast into %s"
+                % (tuple(odata.shape), self.shape))
+        mesh, split = self._mesh, self._split
+
+        def build():
+            def run(a, b):
+                out = op(b, a) if reverse else op(a, b)
+                return _constrain(out, mesh, split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("ew", opname, self.shape, tuple(odata.shape),
+                          str(self.dtype), str(odata.dtype), split, reverse,
+                          mesh), build)
+        return self._wrap(fn(self._data, odata), split)
+
+    def __add__(self, other):
+        return self._elementwise(other, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._elementwise(other, jnp.subtract)
+
+    def __rsub__(self, other):
+        return self._elementwise(other, jnp.subtract, reverse=True)
+
+    def __mul__(self, other):
+        return self._elementwise(other, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._elementwise(other, jnp.divide)
+
+    def __rtruediv__(self, other):
+        return self._elementwise(other, jnp.divide, reverse=True)
+
+    def __pow__(self, other):
+        return self._elementwise(other, jnp.power)
+
+    def __mod__(self, other):
+        return self._elementwise(other, jnp.mod)
+
+    def _unary(self, op):
+        if self._split:
+            return self.map(op, axis=tuple(range(self._split)))
+        return self._wrap(
+            _cached_jit((op.__name__ + "0", self.shape, str(self.dtype),
+                         self._mesh),
+                        lambda: jax.jit(op))(self._data), 0)
+
+    def __neg__(self):
+        # jnp.negative matches numpy in rejecting boolean negate, keeping
+        # the two backends' semantics identical
+        return self._unary(jnp.negative)
+
+    def __abs__(self):
+        return self._unary(jnp.abs)
+
+    def __lt__(self, other):
+        return self._elementwise(other, jnp.less)
+
+    def __le__(self, other):
+        return self._elementwise(other, jnp.less_equal)
+
+    def __gt__(self, other):
+        return self._elementwise(other, jnp.greater)
+
+    def __ge__(self, other):
+        return self._elementwise(other, jnp.greater_equal)
+
+    def __eq__(self, other):
+        try:
+            return self._elementwise(other, jnp.equal)
+        except Exception:
+            # non-comparable operand (None, sentinels): let Python fall
+            # back to identity comparison
+            return NotImplemented
+
+    def __ne__(self, other):
+        try:
+            return self._elementwise(other, jnp.not_equal)
+        except Exception:
+            return NotImplemented
+
+    __hash__ = None
 
     # ------------------------------------------------------------------
     # re-axis: THE signature operation

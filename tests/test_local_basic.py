@@ -75,3 +75,27 @@ def test_repr():
     b = bolt.array(_x())
     r = repr(b)
     assert "local" in r and "shape" in r
+
+
+def test_stats():
+    x = _x()
+    b = bolt.array(x)
+    c = b.stats()
+    assert c.count() == x.shape[0]
+    assert allclose(c.mean(), x.mean(axis=0))
+    assert allclose(c.variance(), x.var(axis=0))
+    assert allclose(c.stdev(), x.std(axis=0))
+    assert allclose(c.max(), x.max(axis=0))
+    assert allclose(c.min(), x.min(axis=0))
+    c = b.stats(axis=(0, 1))
+    assert allclose(c.mean(), x.mean(axis=(0, 1)))
+
+
+def test_stats_cross_backend(mesh):
+    # the same stats() contract on both backends
+    x = _x()
+    cl = bolt.array(x).stats()
+    ct = bolt.array(x, mesh).stats()
+    assert cl.count() == ct.count()
+    assert allclose(cl.mean(), ct.mean())
+    assert allclose(cl.variance(), ct.variance())

@@ -1,0 +1,40 @@
+"""Profiling/timing instrumentation tests (the tracing slot of SURVEY §5)."""
+
+import os
+
+import numpy as np
+
+import bolt_tpu as bolt
+from bolt_tpu import profile
+
+
+def test_timeit_and_throughput(mesh):
+    b = bolt.ones((8, 32), mesh)
+    result, secs = profile.timeit(lambda: b.map(lambda v: v * 2).sum()._data,
+                                  iters=2, warmup=1)
+    assert secs > 0
+    assert np.allclose(np.asarray(result), np.full(32, 16.0))
+    gbps = profile.throughput(profile.array_bytes(b), secs)
+    assert gbps > 0
+
+
+def test_array_bytes(mesh):
+    b = bolt.ones((8, 4), mesh, dtype=np.float32)
+    assert profile.array_bytes(b) == 8 * 4 * 4
+
+
+def test_annotate_and_trace(tmp_path, mesh):
+    with profile.annotate("bolt-test-region"):
+        bolt.ones((8, 2), mesh).sum().toarray()
+    logdir = str(tmp_path / "trace")
+    with profile.trace(logdir):
+        bolt.ones((8, 2), mesh).sum().toarray()
+    assert os.path.isdir(logdir)
+
+
+def test_debug_nans_toggle():
+    import jax
+    profile.debug_nans(True)
+    assert jax.config.jax_debug_nans
+    profile.debug_nans(False)
+    assert not jax.config.jax_debug_nans

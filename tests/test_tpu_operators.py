@@ -1,0 +1,124 @@
+"""Elementwise operator tests (a deliberate superset of the reference: its
+Spark array routes elementwise math through ``map`` — SURVEY §2.2)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+
+def _x():
+    rs = np.random.RandomState(12)
+    return rs.randn(8, 4, 5)
+
+
+def test_scalar_ops(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    assert allclose((b + 1).toarray(), x + 1)
+    assert allclose((1 + b).toarray(), 1 + x)
+    assert allclose((b - 2).toarray(), x - 2)
+    assert allclose((2 - b).toarray(), 2 - x)
+    assert allclose((b * 3).toarray(), x * 3)
+    assert allclose((b / 2).toarray(), x / 2)
+    assert allclose((2 / (b + 10)).toarray(), 2 / (x + 10))
+    assert allclose((b ** 2).toarray(), x ** 2)
+    assert allclose((-b).toarray(), -x)
+    assert allclose(abs(b).toarray(), abs(x))
+
+
+def test_scalar_ops_defer(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    m = (b + 1) * 2 - 3
+    assert m.deferred  # scalar ops fuse into the map chain
+    assert allclose(m.toarray(), (x + 1) * 2 - 3)
+
+
+def test_array_operand(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    other = np.random.RandomState(13).randn(*x.shape)
+    assert allclose((b + other).toarray(), x + other)
+    assert allclose((b * other).toarray(), x * other)
+    # broadcasting into the full shape
+    row = np.random.RandomState(14).randn(5)
+    assert allclose((b + row).toarray(), x + row)
+    with pytest.raises(ValueError):
+        b + np.ones((9, 1, 1))  # does not broadcast into (8, 4, 5)
+
+
+def test_bolt_operand(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    c = bolt.array(x * 2, mesh)
+    out = b + c
+    assert out.split == 1
+    assert allclose(out.toarray(), x * 3)
+    # local bolt array operand
+    out = b + bolt.array(np.ones_like(x))
+    assert allclose(out.toarray(), x + 1)
+
+
+def test_comparisons(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    assert allclose((b > 0).toarray(), x > 0)
+    assert allclose((b <= 0.5).toarray(), x <= 0.5)
+    assert (b == b).toarray().all()
+    assert not (b != b).toarray().any()
+    assert (b > 0).dtype == np.bool_
+
+
+def test_value_shaped_result_ops(mesh):
+    # operators on a split=0 reduction result
+    x = _x()
+    s = bolt.array(x, mesh).sum()
+    assert s.split == 0
+    assert allclose((s + 1).toarray(), x.sum(axis=0) + 1)
+    assert allclose(abs(s).toarray(), abs(x.sum(axis=0)))
+
+
+def test_mixed_expression(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = ((b + 1) * (b - 1)).mean()
+    assert allclose(out.toarray(), ((x + 1) * (x - 1)).mean(axis=0))
+
+
+def test_numpy_left_operand_reflects(mesh):
+    # numpy must defer to __radd__ etc. instead of gathering via __array__
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = np.ones_like(x) + b
+    assert isinstance(out, type(b))
+    assert allclose(out.toarray(), x + 1)
+    out = np.float64(2.0) * b
+    assert isinstance(out, type(b))
+    assert allclose(out.toarray(), x * 2)
+
+
+def test_eq_sentinel(mesh):
+    b = bolt.array(_x(), mesh)
+    assert (b == None) is False      # noqa: E711 — the point of the test
+    assert (b != None) is True       # noqa: E711
+    assert (b == "nope") is False
+
+
+def test_neg_bool_parity(mesh):
+    x = _x()
+    with pytest.raises(TypeError):
+        -(x > 0)                     # the numpy oracle rejects bool negate
+    with pytest.raises(TypeError):
+        -(bolt.array(x, mesh) > 0)   # and so must the TPU backend
+
+
+def test_scalar_ops_cache_stable(mesh):
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    b = bolt.array(_x(), mesh)
+    (b + 1.0).sum().toarray()
+    before = len(_JIT_CACHE)
+    for _ in range(5):
+        (b + 1.0).sum().toarray()
+    assert len(_JIT_CACHE) == before  # identical expressions reuse programs
