@@ -38,6 +38,34 @@ def key_spec(mesh, shape, split):
     return P(*spec)
 
 
+def combined_spec(mesh, shape, split, value_axes=None):
+    """:func:`key_spec` plus explicit value-axis → mesh-axis assignments.
+
+    ``value_axes`` maps a value-axis index (relative to the value group) to
+    a mesh axis name — the sequence/context-parallel analog: the long
+    contiguous dimension itself is split across devices (the reference
+    scales such axes past one worker's memory with ``ChunkedArray`` blocks;
+    SURVEY §2.4 maps that to value-axis sharding on the mesh)."""
+    spec = list(key_spec(mesh, shape, split))
+    if value_axes:
+        used = {s for s in spec if s is not None}
+        for va, name in value_axes.items():
+            ax = split + va
+            if ax < split or ax >= len(shape):
+                raise ValueError("value axis %d out of range" % (va,))
+            if name not in mesh.axis_names:
+                raise ValueError("unknown mesh axis %r" % (name,))
+            if name in used:
+                raise ValueError("mesh axis %r already assigned" % (name,))
+            if shape[ax] % mesh.shape[name] != 0:
+                raise ValueError(
+                    "value axis %d (size %d) is not divisible by mesh axis "
+                    "%r (size %d)" % (va, shape[ax], name, mesh.shape[name]))
+            spec[ax] = name
+            used.add(name)
+    return P(*spec)
+
+
 def key_sharding(mesh, shape, split):
     """``NamedSharding`` for a bolt array of ``shape`` with ``split`` leading
     key axes (see :func:`key_spec`)."""

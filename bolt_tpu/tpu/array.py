@@ -864,8 +864,14 @@ class BoltArrayTPU(BoltArray):
     def toarray(self):
         """Gather to a host ``numpy.ndarray`` in key order (reference:
         ``BoltArraySpark.toarray`` = sortByKey → collect → reshape; here a
-        single ``device_get`` — ordering is intrinsic, SURVEY §3.5)."""
-        return np.asarray(jax.device_get(self._data))
+        single ``device_get`` — ordering is intrinsic, SURVEY §3.5).  On a
+        multi-host mesh, shards the local process cannot address are
+        all-gathered over DCN first."""
+        data = self._data
+        if not data.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            data = multihost_utils.process_allgather(data, tiled=True)
+        return np.asarray(jax.device_get(data))
 
     def __array__(self, dtype=None):
         a = self.toarray()

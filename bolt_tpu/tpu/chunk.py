@@ -21,18 +21,35 @@ reassembles with the same recursive concatenate tree the reference's
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding
 
+from bolt_tpu.parallel.sharding import combined_spec
 from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit, _constrain, _traceable
 from bolt_tpu.utils import iterexpand, prod, tupleize
+
+
+def _constrain_chunked(out, mesh, split, vshard):
+    """Sharding constraint preserving explicit value-axis shards where the
+    output shape still divides; key-only sharding otherwise."""
+    if vshard:
+        try:
+            spec = combined_spec(mesh, out.shape, split, vshard)
+            return jax.lax.with_sharding_constraint(
+                out, NamedSharding(mesh, spec))
+        except ValueError:
+            pass
+    return _constrain(out, mesh, split)
 
 
 class ChunkedArray:
     """A chunk-plan view over a :class:`BoltArrayTPU`."""
 
-    def __init__(self, barray, plan, padding):
+    def __init__(self, barray, plan, padding, vshard=None):
         self._barray = barray
         self._plan = tuple(int(p) for p in plan)
         self._padding = tuple(int(p) for p in padding)
+        # value-axis -> mesh-axis shards (sequence-parallel analog)
+        self._vshard = dict(vshard) if vshard else {}
 
     # ------------------------------------------------------------------
     # construction (reference: ``ChunkedArray._chunk``)
@@ -136,6 +153,37 @@ class ChunkedArray:
         """True when every chunk has the same shape (no ragged tail)."""
         return all(v % c == 0 for v, c in zip(self.vshape, self._plan))
 
+    @property
+    def vshard(self):
+        """Value-axis → mesh-axis shards (empty unless :meth:`shard`-ed)."""
+        return dict(self._vshard)
+
+    # ------------------------------------------------------------------
+    # value-axis sharding: the sequence/context-parallel analog.  The
+    # reference scales a too-long contiguous axis by chunking it over
+    # workers (SURVEY §2.4 "block/chunk decomposition ... closest analog to
+    # sequence parallelism"); here the axis is split across the mesh
+    # itself, and padded per-block maps get their halos from GSPMD's
+    # inserted neighbour collectives.
+    # ------------------------------------------------------------------
+
+    def shard(self, mesh_axis, axis=None):
+        """Shard a chunked value axis across the (unused) mesh axis
+        ``mesh_axis``.  ``axis`` defaults to the first chunked value axis.
+        Returns a new :class:`ChunkedArray` whose underlying data is
+        resharded (an ICI scatter, no host round-trip)."""
+        b = self._barray
+        if axis is None:
+            chunked = [i for i, (v, c) in enumerate(zip(self.vshape, self._plan))
+                       if c < v]
+            axis = chunked[0] if chunked else 0
+        vshard = dict(self._vshard)
+        vshard[axis] = mesh_axis
+        spec = combined_spec(b.mesh, b.shape, b.split, vshard)  # validates
+        data = jax.device_put(b._data, NamedSharding(b.mesh, spec))
+        return ChunkedArray(BoltArrayTPU(data, b.split, b.mesh),
+                            self._plan, self._padding, vshard)
+
     # ------------------------------------------------------------------
     # per-block map (reference: ``ChunkedArray.map`` with padding trim)
     # ------------------------------------------------------------------
@@ -160,8 +208,34 @@ class ChunkedArray:
         pad = self._padding
         grid = self.grid
         padded = any(p > 0 for p in pad)
+        vshard = dict(self._vshard)
+        vs_key = tuple(sorted(vshard.items()))
 
         if self.uniform and not padded:
+            # decide the OUTPUT's value sharding up front so the returned
+            # metadata matches what the constraint actually applies: a
+            # shape-changing block func can break divisibility, in which
+            # case the axis really is re-replicated and we say so
+            out_vshard = vshard
+            try:
+                ob_shape = tuple(jax.eval_shape(
+                    func, jax.ShapeDtypeStruct(tuple(plan), b._aval.dtype)).shape)
+            except Exception:
+                ob_shape = None
+            if ob_shape is not None and len(ob_shape) == nv and vshard:
+                out_full = kshape + tuple(
+                    g * o for g, o in zip(grid, ob_shape))
+                try:
+                    combined_spec(mesh, out_full, split, vshard)
+                except ValueError:
+                    import warnings
+                    warnings.warn(
+                        "chunked map output no longer divides the mesh for "
+                        "value shard %s; the axis is now replicated" % (vshard,))
+                    out_vshard = {}
+            vshard = out_vshard
+            vs_key = tuple(sorted(vshard.items()))
+
             def build():
                 def run(data):
                     newshape = kshape + tuple(
@@ -185,14 +259,15 @@ class ChunkedArray:
                     out = jnp.transpose(out, perm)
                     merged = kshape + tuple(g * o for g, o in zip(grid, ob))
                     out = out.reshape(merged)
-                    return _constrain(out, mesh, split)
+                    return _constrain_chunked(out, mesh, split, vshard)
                 return jax.jit(run)
 
             fn = _cached_jit(("chunk-map-u", func, b.shape, str(b.dtype),
-                             split, plan, mesh), build)
+                             split, plan, vs_key, mesh), build)
             out = fn(b._data)
             new_plan = tuple(o // g for o, g in zip(out.shape[split:], grid))
-            return ChunkedArray(BoltArrayTPU(out, split, mesh), new_plan, pad)
+            return ChunkedArray(BoltArrayTPU(out, split, mesh), new_plan, pad,
+                                vshard)
 
         # general path: ragged tails and/or halo padding — static grid
         # unrolled at trace time, one compiled program
@@ -231,13 +306,13 @@ class ChunkedArray:
                     return jnp.concatenate(parts, axis=split + level)
 
                 out = rec([], 0)
-                return _constrain(out, mesh, split)
+                return _constrain_chunked(out, mesh, split, vshard)
             return jax.jit(run)
 
         fn = _cached_jit(("chunk-map-g", func, b.shape, str(b.dtype),
-                          split, plan, pad, mesh), build)
+                          split, plan, pad, vs_key, mesh), build)
         out = fn(b._data)
-        return ChunkedArray(BoltArrayTPU(out, split, mesh), plan, pad)
+        return ChunkedArray(BoltArrayTPU(out, split, mesh), plan, pad, vshard)
 
     # ------------------------------------------------------------------
     # axis exchange (reference: ``ChunkedArray.keys_to_values`` /
@@ -266,7 +341,10 @@ class ChunkedArray:
             moved = [min(int(s), m) for s, m in zip(sizes, moved)]
         new_plan = tuple(moved) + self._plan
         new_pad = (0,) * len(moved) + self._padding
-        return ChunkedArray(swapped, new_plan, new_pad)
+        # surviving value axes shift right by the number moved in
+        new_vshard = {va + len(moved): name
+                      for va, name in self._vshard.items()}
+        return self._rewrap(swapped, new_plan, new_pad, new_vshard)
 
     def values_to_keys(self, axes):
         """Move value axes into the keys (appended after the existing key
@@ -281,7 +359,30 @@ class ChunkedArray:
         keep = [i for i in range(nv) if i not in axes]
         new_plan = tuple(self._plan[i] for i in keep)
         new_pad = tuple(self._padding[i] for i in keep)
-        return ChunkedArray(swapped, new_plan, new_pad)
+        new_vshard = {pos: self._vshard[old]
+                      for pos, old in enumerate(keep) if old in self._vshard}
+        return self._rewrap(swapped, new_plan, new_pad, new_vshard)
+
+    def _rewrap(self, barray, plan, padding, vshard):
+        """Wrap a swapped underlying array, re-applying value-axis shards
+        that survived the swap (the swap itself constrains to key-only
+        sharding, which would silently re-replicate a long axis the user
+        sharded to fit memory)."""
+        if vshard:
+            try:
+                spec = combined_spec(barray.mesh, barray.shape, barray.split,
+                                     vshard)
+            except ValueError:
+                import warnings
+                warnings.warn(
+                    "value-axis shard %s no longer divides after the axis "
+                    "exchange; the axis is now replicated" % (vshard,))
+                vshard = {}
+            else:
+                data = jax.device_put(
+                    barray._data, NamedSharding(barray.mesh, spec))
+                barray = BoltArrayTPU(data, barray.split, barray.mesh)
+        return ChunkedArray(barray, plan, padding, vshard)
 
     # ------------------------------------------------------------------
 

@@ -63,7 +63,17 @@ class ConstructTPU:
         inshape(a.shape, axes)
         rest = [i for i in range(a.ndim) if i not in axes]
         a = np.transpose(a, axes + rest)
-        data = jax.device_put(a, key_sharding(mesh, a.shape, len(axes)))
+        sharding = key_sharding(mesh, a.shape, len(axes))
+        if any(d.process_index != jax.process_index()
+               for d in np.asarray(mesh.devices).flat):
+            # multi-host mesh: every process holds (or can produce) the
+            # full logical array; each device picks out its own shard —
+            # the single-controller construction path (SURVEY §7 hard
+            # part 6)
+            data = jax.make_array_from_callback(
+                a.shape, sharding, lambda idx: a[idx])
+        else:
+            data = jax.device_put(a, sharding)
         return BoltArrayTPU(data, len(axes), mesh)
 
     @staticmethod

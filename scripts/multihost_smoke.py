@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Multi-host smoke test: the full pipeline on a 2-process CPU mesh.
+
+The reference's multi-node story is the Spark cluster manager; ours is
+``jax.distributed`` + one global mesh spanning hosts (SURVEY §2.5, §7 hard
+part 6).  This script launches TWO OS processes, each owning 4 virtual CPU
+devices, forms the 8-device global mesh, and runs construct → map → sum →
+Welford stats → toarray across it — collectives ride the (simulated) DCN.
+
+Run directly: ``python scripts/multihost_smoke.py``
+"""
+
+import os
+import subprocess
+import sys
+
+NPROC = 2
+DEVS_PER_PROC = 4
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def worker(pid):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=%d" % DEVS_PER_PROC)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:%s" % os.environ["SMOKE_PORT"],
+        num_processes=NPROC, process_id=pid)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu.parallel import make_mesh
+
+    assert len(jax.devices()) == NPROC * DEVS_PER_PROC, jax.devices()
+    mesh = make_mesh((NPROC * DEVS_PER_PROC,), ("k",))
+
+    x = np.arange(8 * 6 * 4, dtype=np.float64).reshape(8, 6, 4)
+    b = bolt.array(x, mesh)
+    assert not b._data.is_fully_addressable
+
+    m = b.map(lambda v: v * 2 + 1)
+    total = m.sum(axis=(0, 1, 2))
+    expected = (x * 2 + 1).sum()
+    got = float(np.asarray(jax.device_get(total._data)))
+    assert got == expected, (got, expected)
+
+    st = b.stats()
+    assert np.allclose(np.asarray(st.mean()), x.mean(axis=0))
+
+    s = b.swap((0,), (1,))
+    assert s.shape == (4, 8, 6)
+
+    full = m.toarray()  # cross-host allgather path
+    assert np.allclose(full, x * 2 + 1)
+
+    print("worker %d OK" % pid, flush=True)
+
+
+def main():
+    env = dict(os.environ)
+    env["SMOKE_PORT"] = str(_free_port())  # never collide with a stale run
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", str(pid)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(NPROC)]
+    ok = True
+    try:
+        for pid, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                ok = False
+                print("--- worker %d TIMED OUT ---" % pid)
+                continue
+            text = out.decode(errors="replace")
+            if p.returncode != 0 or ("worker %d OK" % pid) not in text:
+                ok = False
+                print("--- worker %d FAILED (rc=%s) ---" % (pid, p.returncode))
+                print(text[-4000:])
+    finally:
+        # never orphan a worker holding the coordinator port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    print("multihost smoke:", "PASS" if ok else "FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        worker(int(sys.argv[2]))
+    else:
+        main()

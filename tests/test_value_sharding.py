@@ -1,0 +1,148 @@
+"""Value-axis sharding (sequence/context-parallel analog) and explicit
+halo exchange — the long-context machinery (SURVEY §2.4 block/chunk
+decomposition row; §5 long-context subsystem)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import bolt_tpu as bolt
+from bolt_tpu.parallel import combined_spec, exchange_halo
+from bolt_tpu.utils import allclose
+
+
+def _x(shape=(4, 16, 6)):
+    rs = np.random.RandomState(20)
+    return rs.randn(*shape)
+
+
+def test_combined_spec(mesh2d):
+    spec = combined_spec(mesh2d, (4, 16, 6), 1, {0: "b"})
+    assert tuple(spec) == ("a", "b", None)
+    with pytest.raises(ValueError):
+        combined_spec(mesh2d, (4, 16, 6), 1, {0: "a"})  # already assigned
+    with pytest.raises(ValueError):
+        combined_spec(mesh2d, (4, 15, 6), 1, {0: "b"})  # 15 % 2 != 0
+    with pytest.raises(ValueError):
+        combined_spec(mesh2d, (4, 16, 6), 1, {0: "zz"})  # unknown axis
+
+
+def test_chunk_shard_places_data(mesh2d):
+    x = _x()
+    b = bolt.array(x, mesh2d)  # key (4,) on 'a'; 'b' free
+    c = b.chunk(size=(8,), axis=(0,)).shard("b")
+    assert c.vshard == {0: "b"}
+    data = c._barray._data
+    assert len(data.addressable_shards) == 8
+    # (4/4, 16/2, 6) per shard
+    assert data.addressable_shards[0].data.shape == (1, 8, 6)
+    assert allclose(c.unchunk().toarray(), x)
+
+
+def test_sharded_chunk_map(mesh2d):
+    x = _x()
+    c = bolt.array(x, mesh2d).chunk(size=(8,), axis=(0,)).shard("b")
+    out = c.map(lambda blk: blk * 2 + 1)
+    assert out.vshard == {0: "b"}
+    assert allclose(out.unchunk().toarray(), x * 2 + 1)
+    # output keeps the value-axis shard (no silent re-replication)
+    spec = out._barray._data.sharding.spec
+    assert tuple(spec)[:2] == ("a", "b")
+
+
+def test_sharded_padded_map(mesh2d):
+    # halo-padded block map across a SHARDED value axis: GSPMD supplies the
+    # neighbour data for the overlapping slices
+    x = _x()
+    c = bolt.array(x, mesh2d).chunk(size=(4,), axis=(0,), padding=1).shard("b")
+    out = c.map(lambda blk: blk * 3)
+    assert allclose(out.unchunk().toarray(), x * 3)
+
+
+def test_shard_default_axis(mesh2d):
+    x = _x()
+    c = bolt.array(x, mesh2d).chunk(size=(8,), axis=(0,))
+    assert c.shard("b").vshard == {0: "b"}
+
+
+def test_exchange_halo(mesh):
+    # moving-sum across shard boundaries: explicit ppermute halo
+    n = 8
+    x = np.arange(n * 4, dtype=np.float64).reshape(n * 4)
+    xg = jax.device_put(
+        jnp.asarray(x), jax.sharding.NamedSharding(mesh, P("k")))
+
+    def kernel(local):
+        padded = exchange_halo(local, 1, 0, "k", mode="zero")
+        # window sum over [i-1, i, i+1]
+        return padded[:-2] + padded[1:-1] + padded[2:]
+
+    out = jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
+                                out_specs=P("k")))(xg)
+    padded_np = np.concatenate([[0.0], x, [0.0]])
+    expected = padded_np[:-2] + padded_np[1:-1] + padded_np[2:]
+    assert allclose(np.asarray(jax.device_get(out)), expected)
+
+
+def test_exchange_halo_wrap(mesh):
+    x = np.arange(16, dtype=np.float64)
+    xg = jax.device_put(
+        jnp.asarray(x), jax.sharding.NamedSharding(mesh, P("k")))
+
+    def kernel(local):
+        padded = exchange_halo(local, 1, 0, "k", mode="wrap")
+        return padded[:-2] + padded[1:-1] + padded[2:]
+
+    out = jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
+                                out_specs=P("k")))(xg)
+    padded_np = np.concatenate([[x[-1]], x, [x[0]]])
+    expected = padded_np[:-2] + padded_np[1:-1] + padded_np[2:]
+    assert allclose(np.asarray(jax.device_get(out)), expected)
+
+
+def test_vshard_survives_axis_exchange(mesh2d):
+    # keys_to_values / values_to_keys must re-apply (re-index) value shards
+    x = _x()
+    c = bolt.array(x, mesh2d, axis=(0,)).chunk(size=(8,), axis=(0,)).shard("b")
+    k2v = c.keys_to_values((0,))
+    # old value axis 0 shifted right by the 1 moved-in key axis
+    assert k2v.vshard == {1: "b"}
+    spec = tuple(k2v._barray._data.sharding.spec)
+    assert "b" in spec
+    assert allclose(k2v.unchunk().toarray(), x)
+    # moving the sharded axis itself into the keys drops its value shard
+    v2k = c.values_to_keys((0,))
+    assert v2k.vshard == {}
+
+
+def test_vshard_dropped_with_warning_on_indivisible_map(mesh2d):
+    import warnings
+    x = _x((4, 16, 6))
+    c = bolt.array(x, mesh2d).chunk(size=(16,), axis=(0,)).shard("b")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = c.map(lambda blk: blk[:15])  # 16 -> 15: no longer divides 'b'
+    assert out.vshard == {}  # metadata matches reality
+    assert any("replicated" in str(x.message) for x in w)
+    assert allclose(out.unchunk().toarray(), x[:, :15, :])
+
+
+def test_halo_pad_exceeds_shard(mesh):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    def kernel(local):
+        return exchange_halo(local, 5, 0, "k")  # shard extent is 2
+    with pytest.raises(ValueError):
+        jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
+                              out_specs=P("k")))(jnp.ones(16))
+
+
+def test_exchange_halo_validation(mesh):
+    def kernel(local):
+        return exchange_halo(local, 1, 0, "k", mode="bogus")
+    with pytest.raises(ValueError):
+        jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
+                              out_specs=P("k")))(jnp.ones(16))
