@@ -216,25 +216,33 @@ class ChunkedArray:
             # metadata matches what the constraint actually applies: a
             # shape-changing block func can break divisibility, in which
             # case the axis really is re-replicated and we say so
-            out_vshard = vshard
-            try:
-                ob_shape = tuple(jax.eval_shape(
-                    func, jax.ShapeDtypeStruct(tuple(plan), b._aval.dtype)).shape)
-            except Exception:
-                ob_shape = None
-            if ob_shape is not None and len(ob_shape) == nv and vshard:
-                out_full = kshape + tuple(
-                    g * o for g, o in zip(grid, ob_shape))
+            if vshard:
+                keep = False
                 try:
-                    combined_spec(mesh, out_full, split, vshard)
-                except ValueError:
+                    ob_shape = tuple(jax.eval_shape(
+                        func,
+                        jax.ShapeDtypeStruct(tuple(plan), b._aval.dtype)).shape)
+                except Exception:
+                    ob_shape = None
+                if ob_shape is not None and len(ob_shape) == nv:
+                    out_full = kshape + tuple(
+                        g * o for g, o in zip(grid, ob_shape))
+                    try:
+                        combined_spec(mesh, out_full, split, vshard)
+                        keep = True
+                    except ValueError:
+                        pass
+                if not keep:
+                    # unverifiable or indivisible output: the constraint
+                    # would fall back to key-only sharding, so the metadata
+                    # must not claim otherwise
                     import warnings
                     warnings.warn(
-                        "chunked map output no longer divides the mesh for "
-                        "value shard %s; the axis is now replicated" % (vshard,))
-                    out_vshard = {}
-            vshard = out_vshard
-            vs_key = tuple(sorted(vshard.items()))
+                        "chunked map output does not (verifiably) divide the "
+                        "mesh for value shard %s; the axis is now replicated"
+                        % (vshard,))
+                    vshard = {}
+                    vs_key = ()
 
             def build():
                 def run(data):

@@ -64,6 +64,16 @@ def test_transposeable_reshapeable():
     assert not isreshapeable((7,), (2, 3))
 
 
+def test_get_kv_shape_axes():
+    from bolt_tpu.utils import get_kv_axes, get_kv_shape
+    assert get_kv_axes((2, 3, 4), (0,)) == ((0,), (1, 2))
+    assert get_kv_axes((2, 3, 4), (1, 2)) == ((1, 2), (0,))
+    assert get_kv_shape((2, 3, 4), (0,)) == ((2,), (3, 4))
+    assert get_kv_shape((2, 3, 4), (2, 0)) == ((2, 4), (3,))
+    with pytest.raises(ValueError):
+        get_kv_shape((2, 3), (5,))
+
+
 def test_allclose_and_prod():
     assert allclose(np.ones(3), np.ones(3))
     assert not allclose(np.ones(3), np.ones(4))
