@@ -97,6 +97,16 @@ def _canon(dtype):
     return jax.dtypes.canonicalize_dtype(np.dtype(dtype))
 
 
+def _check_live(arr):
+    """Guard reads of a buffer that a ``swap(..., donate=True)`` may have
+    consumed — deferred children can hold the donated parent's buffer."""
+    if getattr(arr, "is_deleted", lambda: False)():
+        raise RuntimeError(
+            "the underlying device buffer was donated to a "
+            "swap(..., donate=True) and is no longer readable")
+    return arr
+
+
 def _chain_apply(funcs, split, data):
     """Apply a deferred map chain: each func nested-vmapped over the
     ``split`` leading key axes, in order."""
@@ -123,6 +133,7 @@ class BoltArrayTPU(BoltArray):
         self._mesh = mesh
         # deferred map chain: (base jax.Array, (func, ...)) or None
         self._chain = None
+        self._donated = False
         self._aval = None if data is None else jax.ShapeDtypeStruct(
             data.shape, data.dtype)
 
@@ -158,12 +169,16 @@ class BoltArrayTPU(BoltArray):
     def deferred(self):
         """True while this array is an unmaterialised map chain (the
         analog of an RDD transformation not yet executed)."""
-        return self._concrete is None
+        return self._concrete is None and self._chain is not None
 
     @property
     def _data(self):
         """The concrete sharded ``jax.Array``; materialises a deferred
         chain on first access (one fused compiled program)."""
+        if self._donated:
+            raise RuntimeError(
+                "this array's device buffer was donated to a swap(...,"
+                " donate=True); it can no longer be read")
         if self._concrete is None:
             base, funcs = self._chain
             mesh, split = self._mesh, self._split
@@ -175,9 +190,9 @@ class BoltArrayTPU(BoltArray):
 
             fn = _cached_jit(("chain", funcs, base.shape, str(base.dtype),
                               split, mesh), build)
-            self._concrete = fn(base)
+            self._concrete = fn(_check_live(base))
             self._chain = None
-        return self._concrete
+        return _check_live(self._concrete)
 
     @property
     def keys(self):
@@ -418,7 +433,7 @@ class BoltArrayTPU(BoltArray):
 
         fn = _cached_jit(("reduce", func, funcs, base.shape, str(base.dtype),
                           split, keepdims, mesh), build)
-        return self._wrap(fn(base), new_split)
+        return self._wrap(fn(_check_live(base)), new_split)
 
     # ------------------------------------------------------------------
     # statistics (reference: ``BoltArraySpark._stat/stats`` + StatCounter
@@ -451,7 +466,7 @@ class BoltArrayTPU(BoltArray):
 
         fn = _cached_jit(("stat", name, funcs, base.shape, str(base.dtype),
                           split, axes, keepdims, mesh), build)
-        return self._wrap(fn(base), new_split)
+        return self._wrap(fn(_check_live(base)), new_split)
 
     def mean(self, axis=None, keepdims=False):
         """Mean over ``axis`` (default: all key axes)."""
@@ -626,9 +641,15 @@ class BoltArrayTPU(BoltArray):
     # re-axis: THE signature operation
     # ------------------------------------------------------------------
 
-    def swap(self, kaxes, vaxes, size="150"):
+    def swap(self, kaxes, vaxes, size="150", donate=False):
         """Move key axes ``kaxes`` into the values and value axes ``vaxes``
         into the keys.
+
+        ``donate=True`` hands this array's device buffer to XLA for reuse —
+        essential at HBM-filling sizes, where input + output of a re-axis
+        cannot coexist (a 10 GB swap needs 20 GB without donation).  The
+        donated array becomes unreadable afterwards, like the reference's
+        consumed RDD lineage stage.
 
         New keys = (remaining keys) + (moved-in value axes); new values =
         (moved-out key axes) + (remaining value axes) — the reference's
@@ -657,9 +678,9 @@ class BoltArrayTPU(BoltArray):
         if len(kaxes) == split and len(vaxes) == 0:
             raise ValueError("cannot perform a swap that would leave the "
                              "array with no key axes")
-        return self._do_swap(kaxes, vaxes)
+        return self._do_swap(kaxes, vaxes, donate=donate)
 
-    def _do_swap(self, kaxes, vaxes):
+    def _do_swap(self, kaxes, vaxes, donate=False):
         """The swap lowering without the no-key-axes guard — the chunk
         primitives (``keys_to_values`` over every key axis) legitimately
         produce key-less intermediates, which this representation supports
@@ -678,11 +699,19 @@ class BoltArrayTPU(BoltArray):
         def build():
             def swapper(data):
                 return _constrain(jnp.transpose(data, perm), mesh, new_split)
+            if donate:
+                return jax.jit(swapper, donate_argnums=(0,))
             return jax.jit(swapper)
 
         fn = _cached_jit(("swap", self.shape, str(self.dtype), tuple(perm),
-                          split, new_split, mesh), build)
-        return self._wrap(fn(self._data), new_split)
+                          split, new_split, donate, mesh), build)
+        out = fn(self._data)
+        if donate:
+            # only after a successful dispatch: a compile failure must not
+            # brick an array whose buffer was never consumed
+            self._concrete = None
+            self._donated = True
+        return self._wrap(out, new_split)
 
     def chunk(self, size="150", axis=None, padding=None):
         """Decompose the value axes into chunks; returns a
@@ -821,6 +850,15 @@ class BoltArrayTPU(BoltArray):
     def __getitem__(self, index):
         if not isinstance(index, tuple):
             index = (index,)
+        ell = [n for n, i in enumerate(index) if i is Ellipsis]
+        if len(ell) > 1:
+            raise IndexError("an index can only have a single ellipsis ('...')")
+        if ell:
+            pos = ell[0]
+            fill = self.ndim - (len(index) - 1)
+            if fill < 0:
+                raise ValueError("too many indices for %d-d array" % self.ndim)
+            index = index[:pos] + (slice(None),) * fill + index[pos + 1:]
         if len(index) > self.ndim:
             raise ValueError("too many indices for %d-d array" % self.ndim)
         index = index + (slice(None),) * (self.ndim - len(index))
@@ -856,6 +894,31 @@ class BoltArrayTPU(BoltArray):
 
         out = _cached_jit(key, build)(self._data, arrays)
         return self._wrap(out, new_split)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        """Iterate over the leading axis, like numpy (each item is a bolt
+        array with one fewer dimension).  One compiled take program serves
+        every index (the index is a traced argument, not a cache key)."""
+        n = len(self)
+        mesh = self._mesh
+        new_split = self._split - 1 if self._split > 0 else 0
+
+        def build():
+            def take(data, i):
+                return _constrain(jnp.take(data, i, axis=0), mesh, new_split)
+            return jax.jit(take)
+
+        fn = _cached_jit(("iter-take", self.shape, str(self.dtype),
+                          self._split, mesh), build)
+        data = self._data
+        for i in range(n):
+            yield self._wrap(fn(data, jnp.asarray(i, dtype=jnp.int32)),
+                             new_split)
 
     # ------------------------------------------------------------------
     # conversions / persistence
@@ -958,7 +1021,9 @@ class BoltArrayTPU(BoltArray):
         s += "shape: %s\n" % str(self.shape)
         s += "split: %d\n" % self._split
         s += "dtype: %s\n" % str(self.dtype)
-        if self.deferred:
+        if self._donated:
+            s += "donated: buffer consumed by swap(donate=True)\n"
+        elif self.deferred:
             s += "deferred: %d-op map chain\n" % len(self._chain[1])
         else:
             try:

@@ -41,9 +41,12 @@ def timed(fn, iters=3):
 
 
 def sync(barray):
-    """Force device-side completion of a bolt array via a 1-element probe."""
+    """Force device-side completion of a bolt array via a 1-element probe.
+
+    Indexes (never reshapes): an eager reshape of a TPU array is a physical
+    relayout copy — doubling HBM for a 10 GB operand."""
     data = barray._data
-    return float(np.asarray(jax.device_get(data.reshape(-1)[:1]))[0])
+    return float(np.asarray(jax.device_get(data[(0,) * data.ndim])))
 
 
 ADD1 = lambda v: v + 1
