@@ -1,0 +1,98 @@
+"""Cross-feature edge interactions the per-feature suites don't cover."""
+
+from operator import add
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+
+def _x(shape=(8, 6, 4)):
+    rs = np.random.RandomState(50)
+    return rs.randn(*shape)
+
+
+def test_chunk_of_deferred(mesh):
+    # chunk() on a deferred map chain: shape comes from the aval; map on
+    # the chunks materialises the chain first
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v + 1)
+    c = m.chunk(size=(3,), axis=(0,))
+    assert c.plan == (3, 4)
+    out = c.map(lambda blk: blk * 2).unchunk()
+    assert allclose(out.toarray(), (x + 1) * 2)
+
+
+def test_stacked_of_deferred(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v * 3)
+    out = m.stacked(4).map(lambda blk: blk + 1).unstack()
+    assert allclose(out.toarray(), x * 3 + 1)
+
+
+def test_filter_after_swap(mesh):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    s = b.swap((1,), ())  # keys (8,), values (6, 4)
+    out = s.filter(lambda v: v.sum() > 0)
+    expected = np.asarray([v for v in x if v.sum() > 0])
+    assert allclose(out.toarray(), expected)
+
+
+def test_getitem_on_deferred(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v + 1)
+    assert allclose(m[2:5].toarray(), (x + 1)[2:5])
+
+
+def test_reduce_after_operators(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = ((b * 2) + 1).reduce(add)
+    assert allclose(out.toarray(), (x * 2 + 1).sum(axis=0))
+
+
+def test_concatenate_deferred_operand(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    m = b.map(lambda v: v + 1)
+    out = b.concatenate(m, axis=0)
+    assert allclose(out.toarray(), np.concatenate([x, x + 1], axis=0))
+
+
+def test_welford_on_deferred(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v - 1)
+    st = m.stats()
+    assert allclose(st.mean(), (x - 1).mean(axis=0))
+
+
+def test_keys_view_after_swap(mesh):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    s = b.swap((0,), (0,))  # keys (6, 4), values (8,)
+    assert s.keys.shape == (6, 4)
+    out = s.keys.reshape(24)
+    assert out.split == 1
+    assert out.shape == (24, 8)
+
+
+def test_with_keys_multiaxis(mesh2d):
+    x = _x((4, 2, 5))
+    b = bolt.array(x, mesh2d, axis=(0, 1))
+    out = b.map(lambda kv: kv[1] + kv[0][0] * 10 + kv[0][1],
+                axis=(0, 1), with_keys=True)
+    keys0 = np.arange(4).reshape(4, 1, 1)
+    keys1 = np.arange(2).reshape(1, 2, 1)
+    assert allclose(out.toarray(), x + keys0 * 10 + keys1)
+
+
+def test_empty_key_axis(mesh):
+    # zero-size key axis: degenerate but must not crash
+    x = np.zeros((0, 3, 2))
+    b = bolt.array(x, mesh)
+    assert b.shape == (0, 3, 2)
+    assert b.map(lambda v: v + 1).toarray().shape == (0, 3, 2)
+    assert b.filter(lambda v: True).toarray().shape == (0, 3, 2)
