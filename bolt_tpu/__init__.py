@@ -23,3 +23,15 @@ from bolt_tpu.utils import allclose
 
 __all__ = ["array", "ones", "zeros", "concatenate", "allclose",
            "BoltArray", "BoltArrayLocal", "BoltArrayTPU", "__version__"]
+
+_SUBMODULES = ("checkpoint", "profile", "parallel", "ops", "statcounter",
+               "utils")
+
+
+def __getattr__(name):
+    # lazy submodule access (bolt.checkpoint, bolt.profile, ...) without
+    # importing their heavier dependencies at package import
+    if name in _SUBMODULES:
+        import importlib
+        return importlib.import_module("bolt_tpu." + name)
+    raise AttributeError("module 'bolt_tpu' has no attribute %r" % (name,))

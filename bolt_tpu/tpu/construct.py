@@ -51,30 +51,56 @@ class ConstructTPU:
         moveaxis+reshape).  ``npartitions`` is accepted for signature parity;
         the partition count is the mesh size.
         """
+        from bolt_tpu.base import BoltArray
         from bolt_tpu.tpu.array import BoltArrayTPU
         mesh = ConstructTPU._resolve(context)
+        axes = sorted(tupleize(axis))
+        if len(axes) == 0:
+            raise ValueError("at least one key axis is required")
+
+        if isinstance(a, BoltArrayTPU):
+            a = a._data
+        elif isinstance(a, BoltArray):
+            a = a.toarray()
+
+        inshape(a.shape, axes)
+        rest = [i for i in range(a.ndim) if i not in axes]
+        perm = axes + rest
+        split = len(axes)
+        multihost = any(d.process_index != jax.process_index()
+                        for d in np.asarray(mesh.devices).flat)
+
+        # device arrays stay on device: transpose/cast/reshard without a
+        # host round-trip.  On a multi-host mesh this path also serves
+        # global (non-fully-addressable) inputs, which CANNOT go to host;
+        # a process-LOCAL device array there takes the host path below,
+        # since device_put cannot scatter it across processes.
+        if isinstance(a, jax.Array) and (not multihost
+                                         or not a.is_fully_addressable):
+            data = a if perm == list(range(a.ndim)) else jnp.transpose(a, perm)
+            if dtype is not None:
+                target = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
+                if target != data.dtype:
+                    data = data.astype(target)
+            data = jax.device_put(
+                data, key_sharding(mesh, data.shape, split))
+            return BoltArrayTPU(data, split, mesh)
+
         a = np.asarray(a, dtype=dtype)
         # canonicalise to what the backend holds (f64→f32 unless x64 is on):
         # explicit and silent, not warn-and-truncate
         a = a.astype(jax.dtypes.canonicalize_dtype(a.dtype))
-        axes = sorted(tupleize(axis))
-        if len(axes) == 0:
-            raise ValueError("at least one key axis is required")
-        inshape(a.shape, axes)
-        rest = [i for i in range(a.ndim) if i not in axes]
-        a = np.transpose(a, axes + rest)
-        sharding = key_sharding(mesh, a.shape, len(axes))
-        if any(d.process_index != jax.process_index()
-               for d in np.asarray(mesh.devices).flat):
-            # multi-host mesh: every process holds (or can produce) the
-            # full logical array; each device picks out its own shard —
-            # the single-controller construction path (SURVEY §7 hard
-            # part 6)
+        a = np.transpose(a, perm)
+        sharding = key_sharding(mesh, a.shape, split)
+        if multihost:
+            # every process holds (or can produce) the full logical array;
+            # each device picks out its own shard — the single-controller
+            # construction path (SURVEY §7 hard part 6)
             data = jax.make_array_from_callback(
                 a.shape, sharding, lambda idx: a[idx])
         else:
             data = jax.device_put(a, sharding)
-        return BoltArrayTPU(data, len(axes), mesh)
+        return BoltArrayTPU(data, split, mesh)
 
     @staticmethod
     def _filled(fill, shape, context, axis, dtype):

@@ -84,6 +84,29 @@ def test_context_validation():
         bolt.array(np.ones(3), context="not a mesh", mode="tpu")
 
 
+def test_construct_from_device_array(mesh):
+    # jax.Array / BoltArrayTPU inputs stay on device (no host round-trip)
+    import jax.numpy as jnp
+    x = _x()
+    d = jnp.asarray(x)
+    b = bolt.array(d, mesh)
+    assert isinstance(b, BoltArrayTPU)
+    assert allclose(b.toarray(), x)
+    # re-keying an existing distributed array
+    b2 = bolt.array(b, mesh, axis=(1,))
+    assert b2.shape == (4, 8, 5)
+    assert allclose(b2.toarray(), np.transpose(x, (1, 0, 2)))
+
+
+def test_lazy_submodules():
+    import bolt
+    assert hasattr(bolt.profile, "timeit")
+    assert hasattr(bolt.parallel, "exchange_halo")
+    assert hasattr(bolt.checkpoint, "save")
+    with pytest.raises(AttributeError):
+        bolt.no_such_submodule
+
+
 def test_conversions(mesh):
     x = _x()
     b = bolt.array(x, mesh)
