@@ -62,6 +62,10 @@ class ConstructTPU:
             a = a._data
         elif isinstance(a, BoltArray):
             a = a.toarray()
+        elif not isinstance(a, (np.ndarray, jax.Array)):
+            # plain sequences (list/tuple/nested) need materializing before
+            # the shape checks below
+            a = np.asarray(a, dtype=dtype)
 
         inshape(a.shape, axes)
         rest = [i for i in range(a.ndim) if i not in axes]

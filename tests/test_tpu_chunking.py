@@ -129,6 +129,58 @@ def test_per_chunk_svd_config5(mesh):
     assert allclose(out.unchunk().toarray(), expected)
 
 
+def test_map_padding_per_record(mesh):
+    # the padded/ragged path must apply func per RECORD (vmapped over key
+    # axes), like the uniform path and the reference's per-(key, chunk)
+    # records — a block-max must not leak across keys
+    x = np.zeros((2, 6))
+    x[0, 3] = 10.0  # only record 0 contains the spike
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(2,), axis=(0,), padding=1)
+    out = c.map(lambda blk: blk * 0 + blk.max()).unchunk().toarray()
+    assert out[0].max() == 10.0
+    assert out[1].max() == 0.0  # record 1 never saw record 0's spike
+
+
+def test_map_general_trace_cost_independent_of_grid(mesh):
+    # the general path groups blocks into ≤4 static categories per chunked
+    # axis: func trace count must NOT grow with the number of chunks
+    x = _x((2, 257, 3))  # 257 = 128 chunks of 2 + ragged tail of 1
+    b = bolt.array(x, mesh)
+    c = b.chunk(size=(2,), axis=(0,), padding=1)
+    calls = []
+
+    def f(blk):
+        calls.append(blk.shape)
+        return blk * 2.0
+
+    out = c.map(f)
+    assert allclose(out.unchunk().toarray(), x * 2)
+    assert len(calls) <= 4
+
+
+def test_map_ragged_padded_categories(mesh):
+    # exercise every clamp category: short tail (tail < padding is
+    # impossible since pad < chunk, but tail < chunk clips the
+    # penultimate block's upper halo), two-chunk and one-chunk grids
+    for n, size, p in [(9, 4, 3), (8, 4, 3), (5, 4, 3), (4, 4, 3),
+                       (13, 4, 2), (12, 4, 1), (7, 3, 2), (3, 3, 2)]:
+        x = _x((2, n))
+        b = bolt.array(x, mesh)
+        c = b.chunk(size=(size,), axis=(0,), padding=p)
+        # halo-dependent shape-preserving func: running sum within block
+        out = c.map(lambda blk: blk * 0 + blk.sum()).unchunk().toarray()
+        # oracle: per record, per block, sum over the clamped padded span
+        g = -(-n // size)
+        exp = np.zeros_like(x)
+        for k in range(2):
+            for i in range(g):
+                c0, c1 = i * size, min(n, (i + 1) * size)
+                p0, p1 = max(0, c0 - p), min(n, c1 + p)
+                exp[k, c0:c1] = x[k, p0:p1].sum()
+        assert allclose(out, exp), (n, size, p)
+
+
 def test_keys_to_values(mesh):
     x = _x()
     b = bolt.array(x, mesh, axis=(0, 1))  # keys (8, 6), values (4,)

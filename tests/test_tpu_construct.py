@@ -28,6 +28,18 @@ def test_array_dispatch(mesh):
     assert bolt.array(x, mode="tpu").mode == "tpu"
 
 
+def test_array_from_sequence(mesh):
+    # plain Python sequences are valid array-likes (regression: the
+    # device-array fast path must not reach .shape before coercion)
+    rows = [[1.0, 2.0, 3.0, 4.0]] * 8
+    b = bolt.array(rows, mesh)
+    assert b.shape == (8, 4)
+    assert allclose(b.toarray(), np.asarray(rows))
+    t = bolt.array(tuple(map(tuple, rows)), mesh, dtype=np.float32)
+    assert t.dtype == np.float32
+    assert allclose(t.toarray(), np.asarray(rows, dtype=np.float32))
+
+
 def test_array_axis(mesh):
     x = _x()
     b = bolt.array(x, mesh, axis=(0, 1))
