@@ -15,6 +15,12 @@ Two measurements:
    throughput ratio to the NumPy anchor is computed per-byte, which is
    scale-fair for this bandwidth-bound op.
 
+Throughput is measured at steady state: launches are pipelined (dispatch is
+async) and the host syncs once at the end, so the per-iteration figure is
+compute time, not the host↔device round-trip latency of this environment's
+remote tunnel (~60 ms, measured and logged separately as ``synced``).
+Every pipelined iteration still reads the full array from HBM.
+
 Prints ONE JSON line:
     {"metric": "northstar_10GB_map_sum_throughput_per_chip",
      "value": <GB/s per chip at 10 GB>, "unit": "GB/s",
@@ -52,7 +58,7 @@ def bench_local_config1():
     return float(out), min(times)
 
 
-def bench_tpu(shape):
+def bench_tpu(shape, pipe_iters=50):
     import bolt_tpu as bolt
 
     b = bolt.ones(shape, mode="tpu", dtype=DTYPE)
@@ -60,17 +66,41 @@ def bench_tpu(shape):
     mapper = lambda v: v + 1
     axes = tuple(range(len(shape)))
 
-    def run():
-        # map defers; sum fuses the chain into one compiled pass over HBM
-        return float(b.map(mapper, axis=(0,)).sum(axis=axes).toarray())
+    def launch():
+        # map defers; sum fuses the chain into one compiled pass over HBM;
+        # dispatch is async — the returned array's buffer is a future
+        return b.map(mapper, axis=(0,)).sum(axis=axes)
 
-    out = run()  # compile + warm caches
+    out = float(launch().toarray())  # compile + warm caches
+
+    # latency including the host round-trip (one fetch per iteration)
     times = []
     for _ in range(ITERS):
         t0 = time.perf_counter()
-        out = run()
+        out = float(launch().toarray())
         times.append(time.perf_counter() - t0)
-    return out, min(times)
+    synced = min(times)
+
+    # pure host-fetch round-trip: re-fetch an already-materialised scalar
+    # result (no compute), so it can be subtracted from the pipelined window
+    done = launch()
+    float(done.toarray())
+    rts = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        float(done.toarray())
+        rts.append(time.perf_counter() - t0)
+    roundtrip = min(rts)
+
+    # steady-state throughput: pipeline the launches, sync once at the end
+    # (in-order per-device execution: the last result completing implies all
+    # iterations ran; each reads the full array from HBM); the one closing
+    # fetch's round-trip is subtracted so the figure is device time only
+    t0 = time.perf_counter()
+    results = [launch() for _ in range(pipe_iters)]
+    out = float(results[-1].toarray())
+    steady = (time.perf_counter() - t0 - roundtrip) / pipe_iters
+    return out, steady, synced
 
 
 def main():
@@ -80,8 +110,9 @@ def main():
     local_gbps = _gb(SHAPE1) / local_t
     _log("local: %.3fs (%.2f GB/s)" % (local_t, local_gbps))
 
-    tpu1_out, tpu1_t = bench_tpu(SHAPE1)
-    _log("tpu:   %.4fs (%.2f GB/s)" % (tpu1_t, _gb(SHAPE1) / tpu1_t))
+    tpu1_out, tpu1_t, tpu1_sync = bench_tpu(SHAPE1)
+    _log("tpu:   %.4fs (%.2f GB/s)  [synced incl. host round-trip: %.4fs]"
+         % (tpu1_t, _gb(SHAPE1) / tpu1_t, tpu1_sync))
 
     expected1 = float(np.prod(SHAPE1, dtype=np.float64) * 2.0)
     exact = (tpu1_out == local_out == expected1)
@@ -94,12 +125,12 @@ def main():
     _log("north-star %s (%.2f GB): fused map->sum on device..."
          % (SHAPE10, _gb(SHAPE10)))
     try:
-        tpu10_out, tpu10_t = bench_tpu(SHAPE10)
+        tpu10_out, tpu10_t, tpu10_sync = bench_tpu(SHAPE10)
         gb10 = _gb(SHAPE10)
         gbps10 = gb10 / tpu10_t
         expected10 = float(np.prod(SHAPE10, dtype=np.float64) * 2.0)
-        _log("tpu:   %.4fs (%.2f GB/s)  parity=%r"
-             % (tpu10_t, gbps10, tpu10_out == expected10))
+        _log("tpu:   %.4fs (%.2f GB/s)  parity=%r  [synced: %.4fs]"
+             % (tpu10_t, gbps10, tpu10_out == expected10, tpu10_sync))
         result = {
             "metric": "northstar_10GB_map_sum_throughput_per_chip",
             "value": round(gbps10, 3),
