@@ -5,15 +5,19 @@
 north-star, timed INCLUDING the scalar result fetch); this script measures
 the full config table from ``BASELINE.json``.
 
-Timing methodology: the TPU column times device-side completion — the
-result is materialised on device and a one-element probe is fetched to
-force synchronisation.  The full-array host transfer is excluded because
-this environment reaches the chip through a remote tunnel whose transfer
-bandwidth (~tens of MB/s) is an attachment artifact, not a property of the
-framework or hardware; parity against the oracle is still asserted on the
-full fetched result, once, outside the timed region.  User functions are
-hoisted so jit caches hit across iterations (defining a lambda inside the
-timed closure would recompile every pass — see README dtype/tracing notes).
+Timing methodology: the TPU column times device-side completion at steady
+state — launches are pipelined (dispatch is async), the host syncs once on
+the last result via a one-element probe, and the probe's measured pure
+round-trip (~65 ms through this environment's remote tunnel — an
+attachment artifact, not a property of the framework or hardware) is
+subtracted.  The full-array host transfer is likewise excluded; parity
+against the oracle is still asserted on the full fetched result, once,
+outside the timed region.  Config 4 (filter) keeps one host sync per
+iteration inside the timed region: its two-phase mask→count→gather
+algorithm inherently reads the count on host (the reference pays a Spark
+job at the same spot).  User functions are hoisted so jit caches
+hit across iterations (defining a lambda inside the timed closure would
+recompile every pass — see README dtype/tracing notes).
 """
 
 import sys
@@ -49,6 +53,31 @@ def sync(barray):
     return float(np.asarray(jax.device_get(data[(0,) * data.ndim])))
 
 
+def timed_tpu(launch, iters=10):
+    """Steady-state device time per iteration.
+
+    ``launch()`` must asynchronously dispatch one full iteration and return
+    the bolt array to synchronise on.  Launches are pipelined (in-order
+    per-device execution: the last result completing implies all ran); the
+    closing probe's pure round-trip is measured on an already-materialised
+    result and subtracted."""
+    tail = launch()
+    sync(tail)  # compile + warm
+    rts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync(tail)
+        rts.append(time.perf_counter() - t0)
+    roundtrip = min(rts)
+    keep = []  # hold references so no buffer is deleted mid-flight
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        keep.append(launch())
+    sync(keep[-1])
+    per_iter = (time.perf_counter() - t0 - roundtrip) / iters
+    return keep[-1], per_iter
+
+
 ADD1 = lambda v: v + 1
 SQRT = np.sqrt
 MEANPOS = lambda v: v.mean() > 0
@@ -65,7 +94,8 @@ def main():
     bt = bolt.ones(shape, mode="tpu", dtype=np.float32).cache()
     axes = tuple(range(4))
     lo, lt = timed(lambda: float((xl + 1).sum(dtype=np.float32)))
-    to, tt = timed(lambda: float(bt.map(ADD1).sum(axis=axes).toarray()))
+    to_arr, tt = timed_tpu(lambda: bt.map(ADD1).sum(axis=axes))
+    to = float(to_arr.toarray())
     rows.append(("1 map->sum 0.66GB", lt, tt, "bit-exact" if lo == to else "MISMATCH"))
 
     # ---- config 2: ufuncs + axis reductions over the split axis ------
@@ -76,16 +106,17 @@ def main():
         m = np.sqrt(x)
         return m.mean(axis=0), m.std(axis=0), m.var(axis=0), m.max(axis=0)
 
+    tpu2_outs = []
+
     def tpu2():
         m = bt.map(SQRT)
-        outs = [getattr(m, n)() for n in ("mean", "std", "var", "max")]
-        sync(outs[-1])
-        return outs
+        tpu2_outs[:] = [getattr(m, n)() for n in ("mean", "std", "var", "max")]
+        return tpu2_outs[-1]
 
     lo, lt = timed(local2)
-    to, tt = timed(tpu2)
+    _, tt = timed_tpu(tpu2)
     ok = all(allclose(a, np.asarray(b.toarray()), rtol=1e-4, atol=1e-5)
-             for a, b in zip(lo, to))
+             for a, b in zip(lo, tpu2_outs))
     rows.append(("2 ufunc+reductions", lt, tt, "allclose" if ok else "MISMATCH"))
 
     # ---- config 3: swap() key<->value exchange on a 4D array ---------
@@ -93,12 +124,7 @@ def main():
     bt = bolt.array(x, mode="tpu", axis=(0, 1)).cache()
     lo_arr, lt = timed(lambda: np.ascontiguousarray(np.transpose(x, (1, 2, 0, 3))))
 
-    def tpu3():
-        s = bt.swap((0,), (0,))
-        sync(s)
-        return s
-
-    to, tt = timed(tpu3)
+    to, tt = timed_tpu(lambda: bt.swap((0,), (0,)), iters=5)
     ok = allclose(lo_arr, to.toarray())
     rows.append(("3 swap all-to-all", lt, tt, "exact" if ok else "MISMATCH"))
 
@@ -107,12 +133,9 @@ def main():
     bt = bolt.array(x, mode="tpu").cache()
     lo_arr, lt = timed(lambda: x[x.mean(axis=(1, 2)) > 0])
 
-    def tpu4():
-        f = bt.filter(MEANPOS)
-        sync(f)
-        return f
-
-    to, tt = timed(tpu4)
+    # each filter() call still pays its inherent count round-trip inside the
+    # timed region; only the closing result probe is amortised away
+    to, tt = timed_tpu(lambda: bt.filter(MEANPOS), iters=5)
     ok = allclose(lo_arr, to.toarray())
     rows.append(("4 filter mask", lt, tt, "exact" if ok else "MISMATCH"))
 
@@ -126,21 +149,19 @@ def main():
             np.linalg.svd(x[k, i * csize:(i + 1) * csize], compute_uv=False)
             for i in range(nchunk)]) for k in range(x.shape[0])])
 
-    def tpu5():
-        out = bt.chunk(size=(csize,), axis=(0,)).map(SVALS).unchunk()
-        sync(out)
-        return out
-
     lo_arr, lt = timed(local5)
-    to, tt = timed(tpu5)
+    to, tt = timed_tpu(
+        lambda: bt.chunk(size=(csize,), axis=(0,)).map(SVALS).unchunk(),
+        iters=5)
     ok = allclose(lo_arr, to.toarray().reshape(lo_arr.shape), rtol=1e-2, atol=1e-2)
     rows.append(("5 per-chunk SVD", lt, tt, "allclose" if ok else "MISMATCH"))
 
     print("%-22s %10s %10s %9s  %s" % ("config", "local s", "tpu s", "speedup", "parity"))
     for name, lt, tt, parity in rows:
         print("%-22s %10.4f %10.4f %8.1fx  %s" % (name, lt, tt, lt / tt, parity))
-    print("(tpu column floor: ~0.07s fixed remote-dispatch round-trip "
-          "through this environment's tunnel)", file=sys.stderr)
+    print("(tpu column: steady-state device time; config 4 alone includes "
+          "one ~0.07s remote round-trip — its count sync is part of the "
+          "algorithm)", file=sys.stderr)
     if any(r[3] == "MISMATCH" for r in rows):
         sys.exit(1)
 
