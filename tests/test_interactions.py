@@ -1,0 +1,110 @@
+"""Cross-feature interaction parity: combinations of deferred chains,
+re-axis, chunking, filtering, and indexing that no single-feature suite
+exercises together.  Oracle idiom as everywhere (SURVEY §4): compute the
+same thing with NumPy and assert ``allclose`` on ``toarray()``."""
+
+import numpy as np
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+
+def _x(shape=(8, 4, 6), seed=11):
+    return np.random.RandomState(seed).randn(*shape)
+
+
+def test_deferred_map_then_swap(mesh):
+    x = _x()
+    s = bolt.array(x, mesh).map(lambda v: v * 2).swap((0,), (1,))
+    assert allclose(s.toarray(), np.transpose(x * 2, (2, 0, 1)))
+
+
+def test_deferred_concat_deferred_value_axis(mesh):
+    x = _x()
+    a1 = bolt.array(x, mesh).map(lambda v: v + 1)
+    a2 = bolt.array(x, mesh).map(lambda v: v - 1)
+    c = a1.concatenate(a2, axis=2)
+    assert allclose(c.toarray(), np.concatenate([x + 1, x - 1], axis=2))
+
+
+def test_filter_map_reduce_chain(mesh):
+    x = _x()
+    out = (bolt.array(x, mesh)
+           .filter(lambda v: v.mean() > 0)
+           .map(lambda v: v * 2)
+           .reduce(np.add))
+    keep = x[x.mean(axis=(1, 2)) > 0]
+    assert allclose(out.toarray(), (keep * 2).sum(axis=0))
+
+
+def test_shape_changing_map_then_swap(mesh):
+    x = _x()
+    b = bolt.array(x, mesh).map(lambda v: v.reshape(24)[:5])
+    s = b.swap((0,), (0,))
+    expected = np.stack([r.reshape(24)[:5] for r in x])
+    assert allclose(s.toarray(), expected.T)
+
+
+def test_chained_swaps_compose(mesh):
+    x = _x()
+
+    def perm(split, ndim, kaxes, vaxes):
+        keys_rest = [k for k in range(split) if k not in kaxes]
+        values_rest = [v for v in range(ndim - split) if v not in vaxes]
+        return tuple(keys_rest + [split + v for v in vaxes]
+                     + list(kaxes) + [split + v for v in values_rest])
+
+    s = bolt.array(x, mesh, axis=(0, 1)).swap((0,), (0,)).swap((0,), (0,))
+    e = np.transpose(np.transpose(x, perm(2, 3, [0], [0])), perm(2, 3, [0], [0]))
+    assert allclose(s.toarray(), e)
+
+
+def test_deferred_padded_chunk_identity(mesh):
+    x = _x()
+    c = (bolt.array(x, mesh).map(lambda v: v - 1)
+         .chunk(size=(2, 3), axis=(0, 1), padding=(1, 1))
+         .map(lambda blk: blk).unchunk())
+    assert allclose(c.toarray(), x - 1)
+
+
+def test_reduce_keepdims_then_squeeze(mesh):
+    x = _x()
+    s = bolt.array(x, mesh).reduce(np.add, keepdims=True).squeeze()
+    assert allclose(s.toarray(), x.sum(axis=0))
+
+
+def test_bool_mask_on_deferred(mesh):
+    x = _x()
+    m = np.array([True, False] * 4)
+    g = bolt.array(x, mesh).map(lambda v: v + 2)[m]
+    assert allclose(g.toarray(), (x + 2)[m])
+
+
+def test_values_view_on_deferred(mesh):
+    x = _x()
+    r = bolt.array(x, mesh).map(lambda v: v + 5).values.reshape(6, 4)
+    assert allclose(r.toarray(), (x + 5).reshape(8, 6, 4))
+
+
+def test_explicit_axis_mesh_pipeline():
+    import jax
+    em = jax.make_mesh((len(jax.devices()),), ("k",))
+    x = _x((7, 4, 6))  # non-divisible key axis
+    b = bolt.array(x, em)
+    assert allclose(b.toarray(), x)
+    assert allclose(np.asarray(b.mean().toarray()), x.mean(axis=0))
+
+
+def test_with_keys_two_axis_parity(mesh):
+    x = _x()
+    f = lambda kv: kv[1] + kv[0][0] + 10 * kv[0][1]
+    m = bolt.array(x, mesh, axis=(0, 1)).map(f, axis=(0, 1), with_keys=True)
+    lo = bolt.array(x).map(f, axis=(0, 1), with_keys=True)
+    assert allclose(m.toarray(), np.asarray(lo))
+
+
+def test_wrong_value_shape_raises(mesh):
+    import pytest
+    x = _x()
+    with pytest.raises((ValueError, TypeError)):
+        bolt.array(x, mesh).map(lambda v: v * 2, value_shape=(9, 9)).toarray()
