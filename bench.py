@@ -28,7 +28,9 @@ Prints ONE JSON line:
 """
 
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -149,5 +151,27 @@ def main():
     print(json.dumps(result))
 
 
+def _watchdog(seconds):
+    """Emit an explicit-failure JSON line and exit if the run wedges.
+
+    The TPU here is attached through a remote pool with lease semantics; a
+    stale grant (e.g. from an earlier killed process) can make backend
+    initialisation block indefinitely.  A hung benchmark records nothing —
+    an honest error line is strictly more informative."""
+    def fire():
+        print(json.dumps({
+            "metric": "northstar_10GB_map_sum_throughput_per_chip",
+            "value": 0, "unit": "GB/s", "vs_baseline": 0,
+            "error": "benchmark exceeded %ds (TPU attach/lease wedged?)"
+                     % seconds}), flush=True)
+        os._exit(2)
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 if __name__ == "__main__":
+    guard = _watchdog(int(os.environ.get("BOLT_BENCH_TIMEOUT", "540")))
     main()
+    guard.cancel()
