@@ -156,5 +156,12 @@ class BoltArrayLocal(np.ndarray, BoltArray):
     def tolocal(self):
         return self
 
+    def tojax(self, context=None, axis=(0,)):
+        """Distribute over ``context`` and unwrap to the sharded
+        ``jax.Array`` (reference: ``bolt/local/array.py ::
+        BoltArrayLocal.tordd(sc, axis)`` — distribute, then unwrap to the
+        engine-native records)."""
+        return self.totpu(context=context, axis=axis).tojax()
+
     def __repr__(self):
         return BoltArray.__repr__(self)

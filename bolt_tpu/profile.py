@@ -73,3 +73,15 @@ def array_bytes(barray):
 def debug_nans(enable=True):
     """Toggle jax's NaN checking for all subsequently compiled programs."""
     jax.config.update("jax_debug_nans", bool(enable))
+
+
+def memory_stats(device=None):
+    """Per-device memory counters (HBM on TPU) as a dict, or ``{}`` where
+    the backend doesn't expose them.  Keys follow the PJRT convention
+    (``bytes_in_use``, ``bytes_limit``, ``peak_bytes_in_use``, ...)."""
+    d = device if device is not None else jax.local_devices()[0]
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        return {}
+    return dict(stats) if stats else {}

@@ -949,6 +949,13 @@ class BoltArrayTPU(BoltArray):
             return self
         return BoltArray.totpu(self, context=context, axis=axis)
 
+    def tojax(self):
+        """Unwrap to the engine-native object: the underlying sharded
+        ``jax.Array`` (materialises a deferred chain first).  Fills the
+        structural slot of the reference's ``BoltArraySpark.tordd`` —
+        unwrap to the RDD of ``(key, value)`` records."""
+        return self._data
+
     def first(self):
         """The value block at the first key tuple (reference:
         ``BoltArraySpark.first`` — a one-record job; here one block

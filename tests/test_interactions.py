@@ -108,3 +108,16 @@ def test_wrong_value_shape_raises(mesh):
     x = _x()
     with pytest.raises((ValueError, TypeError)):
         bolt.array(x, mesh).map(lambda v: v * 2, value_shape=(9, 9)).toarray()
+
+
+def test_tojax_unwraps_engine_native(mesh):
+    import jax
+    x = _x()
+    b = bolt.array(x, mesh).map(lambda v: v + 1)
+    j = b.tojax()
+    assert isinstance(j, jax.Array) and j.shape == x.shape
+    assert allclose(np.asarray(j), x + 1)
+    lo = bolt.array(x)
+    j2 = lo.tojax(mesh)
+    assert isinstance(j2, jax.Array)
+    assert allclose(np.asarray(j2), x)

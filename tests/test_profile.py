@@ -38,3 +38,11 @@ def test_debug_nans_toggle():
     assert jax.config.jax_debug_nans
     profile.debug_nans(False)
     assert not jax.config.jax_debug_nans
+
+
+def test_memory_stats_dict():
+    from bolt_tpu.profile import memory_stats
+    s = memory_stats()
+    assert isinstance(s, dict)  # CPU backend may expose {} or counters
+    for k, v in s.items():
+        assert isinstance(k, str) and isinstance(v, int)
