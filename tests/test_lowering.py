@@ -1,0 +1,78 @@
+"""Communication-lowering contract tests (SURVEY §2.5): the compiled HLO of
+each distributed operation must contain the collective the design maps it
+to — ``swap`` → ``all-to-all`` (the reference's cluster shuffle), Welford
+``stats`` → ``all-reduce`` (the reference's ``rdd.aggregate`` tree), halo
+exchange → ``collective-permute``.  Inspecting the framework's own cached
+compiled programs guards the contract against regressions in how GSPMD
+chooses collectives."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import bolt_tpu as bolt
+
+
+def _hlo_of_cached(kind, arg):
+    """Compiled HLO text of the framework's most recent cached jit program
+    whose cache key starts with ``kind``."""
+    from bolt_tpu.tpu import array as array_mod
+    fns = [v for k, v in array_mod._JIT_CACHE.items() if k[0] == kind]
+    assert fns, "no cached %r program" % kind
+    return fns[-1].lower(arg).compile().as_text()
+
+
+def test_swap_lowers_to_all_to_all(mesh):
+    # out key axis (16) divides the 8-device mesh: GSPMD must use the
+    # bandwidth-optimal all_to_all, not an all-gather
+    x = np.random.RandomState(0).randn(8, 16, 6)
+    b = bolt.array(x, mesh)
+    s = b.swap((0,), (0,))
+    assert s.split == 1
+    txt = _hlo_of_cached("swap", b._data)
+    assert "all-to-all" in txt
+    assert "all-gather" not in txt
+
+
+def test_swap_nondivisible_still_avoids_full_gather(mesh):
+    # out key axis (4) does not divide 8 devices: key_sharding replicates,
+    # which costs an all-gather — allowed, but the result must be correct
+    x = np.random.RandomState(1).randn(8, 4, 6)
+    s = bolt.array(x, mesh).swap((0,), (0,))
+    assert np.allclose(s.toarray(), np.transpose(x, (1, 0, 2)))
+
+
+def test_welford_stats_lowers_to_all_reduce(mesh):
+    x = np.random.RandomState(2).randn(16, 4, 6)
+    b = bolt.array(x, mesh)
+    b.stats()  # populates the welford program cache
+    from bolt_tpu.tpu import stats as stats_mod
+    fns = [v for k, v in stats_mod._WELFORD_CACHE.items() if k[0] == "welford"]
+    assert fns
+    txt = fns[-1].lower(b._data).compile().as_text()
+    assert "all-reduce" in txt          # psum/pmax/pmin over the mesh axis
+
+
+def test_halo_exchange_lowers_to_collective_permute(mesh):
+    from jax.sharding import NamedSharding
+    from bolt_tpu.parallel.halo import exchange_halo
+
+    x = jnp.asarray(np.random.RandomState(3).randn(16, 4))
+    sh = jax.device_put(x, NamedSharding(mesh, P("k")))
+    f = jax.shard_map(lambda d: exchange_halo(d, axis=0, pad=1, axis_name="k"),
+                      mesh=mesh, in_specs=P("k"), out_specs=P("k"))
+    txt = jax.jit(f).lower(sh).compile().as_text()
+    assert "collective-permute" in txt
+
+
+def test_key_reduction_lowers_to_all_reduce(mesh):
+    # sum over the sharded key axis: GSPMD inserts the psum tree
+    x = np.random.RandomState(4).randn(16, 4, 6)
+    b = bolt.array(x, mesh)
+    s = b.sum(axis=(0,))
+    assert np.allclose(np.asarray(s.toarray()), x.sum(axis=0))
+    txt = _hlo_of_cached("stat", b._data)
+    assert "all-reduce" in txt
