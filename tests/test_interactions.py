@@ -121,3 +121,24 @@ def test_tojax_unwraps_engine_native(mesh):
     j2 = lo.tojax(mesh)
     assert isinstance(j2, jax.Array)
     assert allclose(np.asarray(j2), x)
+
+
+def test_exotic_dtype_parity(mesh):
+    rs = np.random.RandomState(3)
+    xb = rs.rand(8, 4) > 0.5
+    b = bolt.array(xb, mesh)
+    assert b.dtype == np.bool_
+    assert (b.map(lambda v: ~v).toarray() == ~xb).all()
+    assert np.asarray(b.sum(axis=(0,)).toarray()).dtype == xb.sum(axis=0).dtype
+
+    xc = (rs.randn(8, 4) + 1j * rs.randn(8, 4)).astype(np.complex128)
+    c = bolt.array(xc, mesh)
+    assert c.dtype == np.complex128
+    assert allclose(c.map(lambda v: v * (1 + 2j)).toarray(), xc * (1 + 2j))
+    assert np.allclose(np.asarray(c.mean().toarray()), xc.mean(axis=0))
+
+    xh = rs.randn(8, 4).astype(np.float16)
+    assert allclose(bolt.array(xh, mesh).map(lambda v: v + 1).toarray(), xh + 1)
+
+    xu = rs.randint(0, 255, (8, 4)).astype(np.uint8)
+    assert (bolt.array(xu, mesh).map(lambda v: v // 2).toarray() == xu // 2).all()
