@@ -8,7 +8,10 @@ from hypothesis import given, settings, strategies as st
 import bolt_tpu as bolt
 from bolt_tpu.utils import allclose
 
-SETTINGS = dict(max_examples=25, deadline=None)
+# BOLT_HYPOTHESIS_EXAMPLES=200 for a deep fuzz run; 25 keeps CI fast
+import os
+SETTINGS = dict(max_examples=int(os.environ.get("BOLT_HYPOTHESIS_EXAMPLES", "25")),
+                deadline=None)
 
 
 @st.composite
@@ -110,3 +113,58 @@ def test_map_reduce_parity(mesh, case):
     b = bolt.array(x, mesh, axis=axes)
     got = b.map(lambda v: v * 2 + 1, axis=axes).reduce(np.add, axis=axes)
     assert allclose(got.toarray(), (x * 2 + 1).sum(axis=axes))
+
+@given(array_and_split(), st.data())
+@settings(**SETTINGS)
+def test_chunk_roundtrip_random_plans(mesh, case, data):
+    x, split = case
+    b = bolt.array(x, mesh, axis=tuple(range(split)))
+    vshape = x.shape[split:]
+    if not vshape:
+        return
+    # a random subset of value axes, random chunk sizes (ragged allowed),
+    # random halo padding
+    naxes = data.draw(st.integers(1, len(vshape)))
+    axes = tuple(sorted(data.draw(
+        st.sets(st.integers(0, len(vshape) - 1),
+                min_size=naxes, max_size=naxes))))
+    sizes = tuple(data.draw(st.integers(1, max(1, vshape[a])))
+                  for a in axes)
+    pad = data.draw(st.integers(0, 1))
+    if pad >= min(sizes):   # framework guard: padding must be < chunk size
+        pad = 0
+    c = b.chunk(size=sizes, axis=axes, padding=pad if pad else None)
+    out = c.map(lambda blk: blk * 2).unchunk()
+    assert allclose(out.toarray(), x * 2)
+
+
+@given(array_and_split(), st.data())
+@settings(**SETTINGS)
+def test_within_group_shaping(mesh, case, data):
+    x, split = case
+    b = bolt.array(x, mesh, axis=tuple(range(split)))
+    nv = x.ndim - split
+    # random within-group permutation
+    kperm = data.draw(st.permutations(list(range(split))))
+    vperm = data.draw(st.permutations(list(range(nv))))
+    perm = tuple(kperm) + tuple(split + v for v in vperm)
+    t = b.transpose(*perm)
+    assert allclose(t.toarray(), np.transpose(x, perm))
+    # value-group flatten via the Values view (order-preserving reshape)
+    if nv:
+        flat = b.values.reshape(int(np.prod(x.shape[split:])))
+        assert allclose(flat.toarray(),
+                        x.reshape(x.shape[:split] + (-1,)))
+
+
+@given(array_and_split(), st.floats(-1.0, 1.0))
+@settings(**SETTINGS)
+def test_filter_parity_random_threshold(mesh, case, thresh):
+    x, split = case
+    axes = tuple(range(split))
+    b = bolt.array(x, mesh, axis=axes)
+    got = b.filter(lambda v: v.mean() > thresh, axis=axes)
+    flat = x.reshape((-1,) + x.shape[split:])
+    expected = flat[flat.mean(axis=tuple(range(1, flat.ndim))) > thresh]
+    assert got.shape == expected.shape
+    assert allclose(got.toarray(), expected)
