@@ -75,3 +75,36 @@ def test_fused_stats_2d_grid():
     assert float(mx) == float(x.max())
     got = float(fused_map_reduce(x, lambda v: v + 1, interpret=True))
     assert np.allclose(got, float((x + 1).sum()), rtol=1e-3)
+
+
+def test_svdvals_tall_skinny_matches_numpy():
+    import numpy as np
+    from bolt_tpu.ops import svdvals
+    rs = np.random.RandomState(9)
+    x = rs.randn(1024, 16).astype(np.float32)
+    got = np.asarray(svdvals(jnp.asarray(x)))
+    expect = np.linalg.svd(x, compute_uv=False)
+    assert np.allclose(got, expect, rtol=1e-3, atol=1e-3)
+    # batched
+    xb = rs.randn(4, 512, 8).astype(np.float32)
+    gotb = np.asarray(svdvals(jnp.asarray(xb)))
+    expectb = np.stack([np.linalg.svd(m, compute_uv=False) for m in xb])
+    assert np.allclose(gotb, expectb, rtol=1e-3, atol=1e-3)
+    # wide input falls back to full SVD
+    xw = rs.randn(8, 64).astype(np.float32)
+    assert np.allclose(np.asarray(svdvals(jnp.asarray(xw))),
+                       np.linalg.svd(xw, compute_uv=False), rtol=1e-3, atol=1e-3)
+
+
+def test_tallskinny_pca_reconstructs_spectrum():
+    import numpy as np
+    from bolt_tpu.ops import tallskinny_pca
+    rs = np.random.RandomState(10)
+    x = rs.randn(2048, 12).astype(np.float32)
+    comps, svals = tallskinny_pca(jnp.asarray(x), k=5)
+    u, s, vt = np.linalg.svd(x, full_matrices=False)
+    assert np.allclose(np.asarray(svals), s[:5], rtol=1e-3, atol=1e-3)
+    # components match up to sign
+    for i in range(5):
+        c = np.asarray(comps)[:, i]
+        assert min(np.linalg.norm(c - vt[i]), np.linalg.norm(c + vt[i])) < 1e-2
