@@ -184,6 +184,26 @@ def fused_stats(x, interpret=None):
             mn[0, 0].astype(x.dtype), mx[0, 0].astype(x.dtype))
 
 
+def _adjoint(x):
+    """Conjugate transpose of the trailing two dims (plain transpose for
+    real dtypes)."""
+    xt = jnp.swapaxes(x, -1, -2)
+    return jnp.conj(xt) if jnp.iscomplexobj(x) else xt
+
+
+def _acc_dtype(dtype):
+    """Accumulation dtype for the Gram matmul: widen half precisions to
+    float32, never narrow (jax rejects a narrower preferred_element_type)."""
+    if dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return dtype
+
+
+def _real_dtype(dtype):
+    return jnp.finfo(dtype).dtype if jnp.issubdtype(dtype, jnp.complexfloating) \
+        else dtype
+
+
 def svdvals(x, gram_ratio=4):
     """Singular values of a (possibly batched) matrix, TPU-first.
 
@@ -200,11 +220,11 @@ def svdvals(x, gram_ratio=4):
     """
     rows, cols = x.shape[-2], x.shape[-1]
     if rows >= gram_ratio * cols:
-        xt = jnp.swapaxes(x, -1, -2)
-        g = jnp.matmul(xt, x, preferred_element_type=jnp.float32)
-        ev = jnp.linalg.eigvalsh(g)                    # ascending
+        g = jnp.matmul(_adjoint(x), x,
+                       preferred_element_type=_acc_dtype(x.dtype))
+        ev = jnp.linalg.eigvalsh(g)                    # ascending, real
         ev = jnp.maximum(ev[..., ::-1], 0.0)           # descending, clamped
-        return jnp.sqrt(ev).astype(x.dtype)
+        return jnp.sqrt(ev).astype(_real_dtype(x.dtype))
     return jnp.linalg.svd(x, compute_uv=False)
 
 
@@ -216,10 +236,15 @@ def tallskinny_pca(x, k=None):
     (``BASELINE`` config 5); here the big matmul is the only pass over
     the data."""
     n, d = x.shape
-    g = jnp.matmul(x.T, x, preferred_element_type=jnp.float32)
+    if n < d:
+        raise ValueError(
+            "tallskinny_pca requires n >= d (got %d x %d): the rank-%d Gram "
+            "matrix would pad the spectrum with zero eigenvalues whose "
+            "eigenvectors are arbitrary; use jnp.linalg.svd" % (n, d, n))
+    g = jnp.matmul(_adjoint(x), x, preferred_element_type=_acc_dtype(x.dtype))
     ev, vec = jnp.linalg.eigh(g)                       # ascending
     ev = jnp.maximum(ev[::-1], 0.0)
     vec = vec[:, ::-1]
     if k is not None:
         ev, vec = ev[:k], vec[:, :k]
-    return vec.astype(x.dtype), jnp.sqrt(ev).astype(x.dtype)
+    return vec.astype(x.dtype), jnp.sqrt(ev).astype(_real_dtype(x.dtype))

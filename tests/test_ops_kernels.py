@@ -108,3 +108,22 @@ def test_tallskinny_pca_reconstructs_spectrum():
     for i in range(5):
         c = np.asarray(comps)[:, i]
         assert min(np.linalg.norm(c - vt[i]), np.linalg.norm(c + vt[i])) < 1e-2
+
+
+def test_svdvals_dtype_breadth():
+    import numpy as np
+    from bolt_tpu.ops import svdvals, tallskinny_pca
+    import pytest
+    rs = np.random.RandomState(11)
+    # float64 under x64 must take the Gram path without TypeError
+    x64 = rs.randn(512, 8)
+    got = np.asarray(svdvals(jnp.asarray(x64)))
+    assert np.allclose(got, np.linalg.svd(x64, compute_uv=False), rtol=1e-6)
+    # complex: Gram needs the conjugate transpose; spectrum is real
+    xc = (rs.randn(512, 8) + 1j * rs.randn(512, 8)).astype(np.complex128)
+    gotc = np.asarray(svdvals(jnp.asarray(xc)))
+    assert not np.iscomplexobj(gotc)
+    assert np.allclose(gotc, np.linalg.svd(xc, compute_uv=False), rtol=1e-6)
+    # wide input to tallskinny_pca is rejected, not silently wrong
+    with pytest.raises(ValueError):
+        tallskinny_pca(jnp.asarray(rs.randn(8, 64)))
