@@ -1,0 +1,20 @@
+"""Compatibility shim: ``import bolt`` works against this framework.
+
+Existing reference user code (``import bolt; bolt.array(x, ctx, axis=(0,))``,
+``barray.map(f).reduce(add)``) runs unchanged — the BASELINE north-star's
+drop-in requirement — with a ``jax.sharding.Mesh`` taking the SparkContext's
+place as the distribution context.
+"""
+
+from bolt_tpu import *          # noqa: F401,F403
+from bolt_tpu import __version__, __all__  # noqa: F401
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        # never forward dunders: forwarding __path__ would make this shim a
+        # pseudo-package and `import bolt.checkpoint` would load modules a
+        # second time under a different name
+        raise AttributeError(name)
+    import bolt_tpu
+    return getattr(bolt_tpu, name)

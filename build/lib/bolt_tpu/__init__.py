@@ -1,0 +1,37 @@
+"""bolt_tpu — a TPU-native unified n-dimensional array.
+
+One API over two backends (reference: ``bolt/__init__.py`` re-exports —
+symbol-level citation, SURVEY.md §0):
+
+* ``mode='local'`` — NumPy, the semantic oracle;
+* ``mode='tpu'``  — a sharded ``jax.Array`` over a device mesh, with
+  ``map``/``reduce``/statistics lowering to compiled SPMD programs and
+  ``swap`` lowering to an ``all_to_all`` resharding.
+
+>>> import bolt_tpu as bolt
+>>> b = bolt.ones((8, 100, 50), context=mesh)   # keys: (8,) on the mesh
+>>> b.map(lambda x: x + 1).sum().toarray()
+"""
+
+__version__ = "0.1.0"
+
+from bolt_tpu.factory import array, concatenate, ones, zeros
+from bolt_tpu.base import BoltArray
+from bolt_tpu.local.array import BoltArrayLocal
+from bolt_tpu.tpu.array import BoltArrayTPU
+from bolt_tpu.utils import allclose
+
+__all__ = ["array", "ones", "zeros", "concatenate", "allclose",
+           "BoltArray", "BoltArrayLocal", "BoltArrayTPU", "__version__"]
+
+_SUBMODULES = ("checkpoint", "profile", "parallel", "ops", "statcounter",
+               "utils")
+
+
+def __getattr__(name):
+    # lazy submodule access (bolt.checkpoint, bolt.profile, ...) without
+    # importing their heavier dependencies at package import
+    if name in _SUBMODULES:
+        import importlib
+        return importlib.import_module("bolt_tpu." + name)
+    raise AttributeError("module 'bolt_tpu' has no attribute %r" % (name,))

@@ -1,0 +1,104 @@
+"""Abstract cross-backend array contract.
+
+Reference parity: ``bolt/base.py :: BoltArray`` — the contract both backends
+implement (``mode``, ``shape``, ``dtype``, ``map/filter/reduce``,
+``toarray``, conversions, ``__repr__``).  Citations are symbol-level; see
+SURVEY.md §0.
+"""
+
+from abc import ABCMeta, abstractmethod
+
+
+class BoltArray(metaclass=ABCMeta):
+    """An n-dimensional array whose axes split into *key axes* (the
+    distributed / parallel domain) and *value axes* (the local block each
+    unit of parallelism holds).
+
+    Backends:
+
+    * ``mode='local'`` — :class:`bolt_tpu.local.array.BoltArrayLocal`, a
+      ``numpy.ndarray`` subclass; the semantic oracle.
+    * ``mode='tpu'`` — :class:`bolt_tpu.tpu.array.BoltArrayTPU`, a sharded
+      ``jax.Array`` over a ``jax.sharding.Mesh``; key axes map onto mesh
+      axes, so the key/value split *is* the sharding spec.
+    """
+
+    _mode = None
+
+    @property
+    def mode(self):
+        """Backend identifier: ``'local'`` or ``'tpu'``."""
+        return self._mode
+
+    @property
+    @abstractmethod
+    def shape(self):
+        """Full logical shape, key axes leading."""
+
+    @property
+    @abstractmethod
+    def dtype(self):
+        """Element dtype."""
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.shape:
+            n *= int(s)
+        return n
+
+    @property
+    @abstractmethod
+    def _constructor(self):
+        """The construction class for this backend (``ConstructLocal`` /
+        ``ConstructTPU``)."""
+
+    # ------------------------------------------------------------------
+    # functional operators (reference: ``bolt/base.py`` abstract methods)
+    # ------------------------------------------------------------------
+
+    @abstractmethod
+    def map(self, func, axis=(0,), value_shape=None, dtype=None, with_keys=False):
+        """Apply ``func`` to the value block at every key."""
+
+    @abstractmethod
+    def filter(self, func, axis=(0,), sort=False):
+        """Keep the records whose value block satisfies ``func``; the
+        surviving records are re-keyed to a flat ``(n,)`` key space."""
+
+    @abstractmethod
+    def reduce(self, func, axis=(0,), keepdims=False):
+        """Combine all value blocks pairwise with the associative binary
+        ``func``."""
+
+    # ------------------------------------------------------------------
+    # conversions
+    # ------------------------------------------------------------------
+
+    @abstractmethod
+    def toarray(self):
+        """Materialise as a host ``numpy.ndarray`` in key order."""
+
+    @abstractmethod
+    def tolocal(self):
+        """Convert to the ``mode='local'`` backend."""
+
+    def totpu(self, context=None, axis=(0,)):
+        """Convert to the ``mode='tpu'`` backend, distributing ``axis`` as
+        key axes over the mesh ``context``.
+
+        Replaces the reference's ``tospark(sc, axis)`` in the same structural
+        slot (reference: ``bolt/local/array.py :: BoltArrayLocal.tospark``).
+        """
+        from bolt_tpu.tpu.construct import ConstructTPU
+        return ConstructTPU.array(self.toarray(), context=context, axis=axis)
+
+    def __repr__(self):
+        s = "BoltArray\n"
+        s += "mode: %s\n" % self.mode
+        s += "shape: %s\n" % str(tuple(self.shape))
+        return s

@@ -1,0 +1,77 @@
+"""Factory / mode dispatch: the single construction entry point.
+
+Reference: ``bolt/factory.py`` — ``array/ones/zeros/concatenate`` over a
+constructor registry ``[('local', ConstructLocal), ('spark',
+ConstructSpark)]`` with dispatch on an execution context in the arguments
+(symbol-level citation, SURVEY.md §0).  Here the registry is ``[('tpu',
+ConstructTPU), ('local', ConstructLocal)]`` and the context that selects the
+distributed backend is a ``jax.sharding.Mesh`` instead of a SparkContext.
+"""
+
+from bolt_tpu.local.construct import ConstructLocal
+from bolt_tpu.tpu.construct import ConstructTPU
+
+# checked in order; the local backend is the fallback
+constructors = [("tpu", ConstructTPU), ("local", ConstructLocal)]
+
+
+def _lookup(*args, **kwargs):
+    """Find the constructor class for the given arguments (reference:
+    ``bolt/factory.py`` dispatch helper)."""
+    mode = kwargs.get("mode")
+    if mode is not None:
+        for name, cls in constructors:
+            if name == mode:
+                return cls
+        raise ValueError("unknown mode %r (known: %s)"
+                         % (mode, [n for n, _ in constructors]))
+    for name, cls in constructors:
+        if cls._argcheck(*args, **kwargs):
+            return cls
+    return ConstructLocal
+
+
+def array(a, context=None, axis=(0,), mode=None, dtype=None, npartitions=None):
+    """Create a bolt array from an array-like.
+
+    ``mode='tpu'`` (or passing a ``Mesh`` as ``context``) distributes
+    ``axis`` as key axes over the mesh; otherwise a local NumPy-backed array
+    is returned (reference: ``bolt/factory.py :: array``).
+    """
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.array(a, dtype=dtype)
+    return ConstructTPU.array(a, context=context, axis=axis, dtype=dtype,
+                              npartitions=npartitions)
+
+
+def ones(shape, context=None, axis=(0,), mode=None, dtype=None):
+    """Bolt array of ones (reference: ``bolt/factory.py :: ones``)."""
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.ones(shape, dtype=dtype)
+    return ConstructTPU.ones(shape, context=context, axis=axis, dtype=dtype)
+
+
+def zeros(shape, context=None, axis=(0,), mode=None, dtype=None):
+    """Bolt array of zeros (reference: ``bolt/factory.py :: zeros``)."""
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.zeros(shape, dtype=dtype)
+    return ConstructTPU.zeros(shape, context=context, axis=axis, dtype=dtype)
+
+
+def concatenate(arrays, axis=0, context=None, mode=None):
+    """Concatenate bolt arrays (reference: ``bolt/factory.py ::
+    concatenate``).  Dispatches on the first array's backend unless
+    overridden."""
+    if isinstance(arrays, (tuple, list)) and len(arrays) and mode is None \
+            and context is None:
+        from bolt_tpu.tpu.array import BoltArrayTPU
+        if isinstance(arrays[0], BoltArrayTPU):
+            return ConstructTPU.concatenate(arrays, axis=axis)
+        return ConstructLocal.concatenate(arrays, axis=axis)
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.concatenate(arrays, axis=axis)
+    return ConstructTPU.concatenate(arrays, axis=axis, context=context)

@@ -1,0 +1,4 @@
+from bolt_tpu.local.array import BoltArrayLocal
+from bolt_tpu.local.construct import ConstructLocal
+
+__all__ = ["BoltArrayLocal", "ConstructLocal"]

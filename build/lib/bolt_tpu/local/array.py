@@ -1,0 +1,167 @@
+"""The ``mode='local'`` backend: a ``numpy.ndarray`` subclass.
+
+This is the semantic oracle — every TPU-backend parity test compares against
+this implementation (reference: ``bolt/local/array.py :: BoltArrayLocal``;
+symbol-level citation, see SURVEY.md §0).
+"""
+
+from functools import reduce as _functools_reduce
+from itertools import product as _product
+
+import numpy as np
+
+from bolt_tpu.base import BoltArray
+from bolt_tpu.utils import inshape, prod, tupleize
+
+
+class BoltArrayLocal(np.ndarray, BoltArray):
+    """NumPy-backed bolt array.
+
+    Being an ``ndarray`` subclass, it inherits the full NumPy operator and
+    reduction surface (``+``, ``mean(axis=...)``, ``T``, slicing, …); the
+    bolt-specific functional operators (``map``/``filter``/``reduce``) treat
+    the ``axis`` argument as the key-axis set, exactly like the distributed
+    backend (reference: ``bolt/local/array.py`` — ``__new__`` view-cast,
+    functional ops via key-axes-to-front reshape).
+    """
+
+    _mode = "local"
+
+    def __new__(cls, array):
+        return np.asarray(array).view(cls)
+
+    @property
+    def mode(self):
+        return self._mode
+
+    @property
+    def _constructor(self):
+        from bolt_tpu.local.construct import ConstructLocal
+        return ConstructLocal
+
+    # ------------------------------------------------------------------
+    # internal: move key axes to the front and flatten them
+    # ------------------------------------------------------------------
+
+    def _kv_reshape(self, axis):
+        """Return ``(flat, key_shape, value_shape)`` where ``flat`` has shape
+        ``(prod(key_shape), *value_shape)`` with key axes moved to the front.
+
+        Reference: the reshape idiom inside
+        ``bolt/local/array.py :: BoltArrayLocal.map``.
+        """
+        axes = sorted(tupleize(axis))
+        inshape(self.shape, axes)
+        rest = [i for i in range(self.ndim) if i not in axes]
+        key_shape = tuple(self.shape[a] for a in axes)
+        value_shape = tuple(self.shape[i] for i in rest)
+        moved = np.transpose(np.asarray(self), axes + rest)
+        flat = moved.reshape((prod(key_shape),) + value_shape)
+        return flat, key_shape, value_shape
+
+    # ------------------------------------------------------------------
+    # functional operators
+    # ------------------------------------------------------------------
+
+    def map(self, func, axis=(0,), value_shape=None, dtype=None, with_keys=False):
+        """Apply ``func`` to the value block at every key tuple.
+
+        ``value_shape``/``dtype`` are accepted for cross-backend signature
+        parity but are inferred from the results here.
+
+        Reference: ``bolt/local/array.py :: BoltArrayLocal.map``.
+        """
+        flat, key_shape, _ = self._kv_reshape(axis)
+        if with_keys:
+            keys = _product(*[range(k) for k in key_shape])
+            items = [func((k, v)) for k, v in zip(keys, flat)]
+        else:
+            items = [func(v) for v in flat]
+        out = np.asarray(items)
+        if dtype is not None:
+            out = out.astype(dtype)
+        return BoltArrayLocal(out.reshape(key_shape + out.shape[1:]))
+
+    def filter(self, func, axis=(0,), sort=False):
+        """Keep value blocks for which ``func`` is truthy; survivors are
+        re-keyed to a flat ``(n,)`` key axis.
+
+        Reference: ``bolt/local/array.py :: BoltArrayLocal.filter``.
+        """
+        flat, _, value_shape = self._kv_reshape(axis)
+        items = [v for v in flat if func(v)]
+        out = np.asarray(items)
+        if len(items) == 0:
+            out = out.reshape((0,) + value_shape)
+        return BoltArrayLocal(out)
+
+    def reduce(self, func, axis=(0,), keepdims=False):
+        """Sequential pairwise combine of all value blocks with ``func``.
+
+        Reference: ``bolt/local/array.py :: BoltArrayLocal.reduce``.
+        """
+        flat, key_shape, value_shape = self._kv_reshape(axis)
+        out = np.asarray(_functools_reduce(func, list(flat)))
+        if out.shape != value_shape:
+            raise ValueError(
+                "reduce produced shape %s, expected value shape %s"
+                % (out.shape, value_shape))
+        if keepdims:
+            out = out.reshape((1,) * len(key_shape) + value_shape)
+        return BoltArrayLocal(out)
+
+    def stats(self, requested=("mean", "var", "std", "min", "max"), axis=None):
+        """Moment statistics over key axes, returned as a
+        :class:`~bolt_tpu.statcounter.StatCounter` — the same contract the
+        TPU backend serves via its shard_map Welford combine (reference:
+        ``BoltArraySpark.stats`` via ``rdd.aggregate(StatCounter)``).
+
+        ``axis=None`` means the leading axis, this backend's default key
+        axis."""
+        from bolt_tpu.statcounter import StatCounter
+        axes = (0,) if axis is None else tuple(sorted(tupleize(axis)))
+        inshape(self.shape, axes)
+        x = np.asarray(self)
+        n = prod(tuple(self.shape[a] for a in axes))
+        mu = x.mean(axis=axes, keepdims=True)
+        m2 = ((x - mu) ** 2).sum(axis=axes)
+        return StatCounter.from_moments(
+            n, np.squeeze(mu, axis=axes), m2,
+            minValue=x.min(axis=axes), maxValue=x.max(axis=axes),
+            stats=requested)
+
+    # ------------------------------------------------------------------
+    # conversions
+    # ------------------------------------------------------------------
+
+    def first(self):
+        """The value block at the first key (axis-0 record).
+
+        Reference: ``bolt/local/array.py :: BoltArrayLocal.first``.
+        """
+        return np.asarray(self)[0]
+
+    def concatenate(self, arry, axis=0):
+        """Concatenate with another array along ``axis``.
+
+        Reference: ``bolt/local/array.py :: BoltArrayLocal.concatenate``.
+        """
+        if isinstance(arry, BoltArray):
+            arry = arry.toarray()
+        return BoltArrayLocal(np.concatenate((np.asarray(self), np.asarray(arry)), axis))
+
+    def toarray(self):
+        return np.asarray(self)
+
+    def tolocal(self):
+        return self
+
+    def tojax(self, context=None, axis=(0,)):
+        """Distribute over ``context`` and unwrap to the sharded
+        ``jax.Array`` (reference: ``bolt/local/array.py ::
+        BoltArrayLocal.tordd(sc, axis)`` — distribute, then unwrap to the
+        engine-native records)."""
+        return self.totpu(context=context, axis=axis).tojax()
+
+    def __repr__(self):
+        return BoltArray.__repr__(self)

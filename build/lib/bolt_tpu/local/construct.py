@@ -1,0 +1,38 @@
+"""Constructors for the ``mode='local'`` backend.
+
+Reference: ``bolt/local/construct.py :: ConstructLocal`` (symbol-level
+citation, see SURVEY.md §0).
+"""
+
+import numpy as np
+
+from bolt_tpu.local.array import BoltArrayLocal
+
+
+class ConstructLocal:
+    """Thin NumPy wrappers returning :class:`BoltArrayLocal`."""
+
+    @staticmethod
+    def _argcheck(*args, **kwargs):
+        """The local backend is the dispatch fallback; it claims a call only
+        when asked for by name (reference: ``bolt/local/construct.py ::
+        ConstructLocal._argcheck``)."""
+        return kwargs.get("mode") == "local"
+
+    @staticmethod
+    def array(a, dtype=None):
+        return BoltArrayLocal(np.asarray(a, dtype=dtype))
+
+    @staticmethod
+    def ones(shape, dtype=None):
+        return BoltArrayLocal(np.ones(shape, dtype=dtype))
+
+    @staticmethod
+    def zeros(shape, dtype=None):
+        return BoltArrayLocal(np.zeros(shape, dtype=dtype))
+
+    @staticmethod
+    def concatenate(arrays, axis=0):
+        if not isinstance(arrays, (tuple, list)) or len(arrays) == 0:
+            raise ValueError("concatenate requires a non-empty tuple of arrays")
+        return BoltArrayLocal(np.concatenate([np.asarray(a) for a in arrays], axis))

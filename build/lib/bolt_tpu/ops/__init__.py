@@ -1,0 +1,4 @@
+from bolt_tpu.ops.kernels import (fused_map_reduce, fused_stats,
+                                  svdvals, tallskinny_pca)
+
+__all__ = ["fused_map_reduce", "fused_stats", "svdvals", "tallskinny_pca"]

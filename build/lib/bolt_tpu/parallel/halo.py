@@ -1,0 +1,66 @@
+"""Explicit halo exchange for shard_map programs.
+
+The reference's chunk ``padding`` ships overlapping blocks through the
+Spark shuffle (``bolt/spark/chunk.py :: ChunkedArray._chunk`` with
+``padding`` — SURVEY §2.4 maps it to ``lax.ppermute`` neighbour exchange).
+When a value axis is sharded across the mesh, each shard needs its
+neighbours' edge slices before windowed/stencil compute; this module is the
+ppermute lowering of that exchange, for users writing explicit
+``shard_map`` kernels.  (The implicit path — slicing a padded window out of
+a global sharded array under jit — is handled by GSPMD automatically; this
+is the explicit-collective counterpart, like ``tpu/stats.py`` is for
+``rdd.aggregate``.)
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def exchange_halo(local, pad, axis, axis_name, mode="zero"):
+    """Inside ``shard_map``: extend ``local`` along ``axis`` with ``pad``
+    elements fetched from the previous/next shard on mesh axis
+    ``axis_name`` via ``lax.ppermute``.
+
+    ``mode='zero'`` fills the outer boundary of the first/last shard with
+    zeros (callers that clip — the reference's semantics — can trim or mask
+    using ``jax.lax.axis_index``); ``mode='wrap'`` exchanges cyclically.
+
+    Returns an array whose ``axis`` is ``2*pad`` longer than ``local``'s.
+    """
+    if pad <= 0:
+        return local
+    if pad > local.shape[axis]:
+        # a halo wider than the shard would need data from beyond the
+        # immediate neighbour; slice() would silently shrink instead
+        raise ValueError(
+            "halo pad %d exceeds the per-shard extent %d on axis %d"
+            % (pad, local.shape[axis], axis))
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    def take(arr, sl):
+        slicer = [slice(None)] * arr.ndim
+        slicer[axis] = sl
+        return arr[tuple(slicer)]
+
+    # my right edge goes to my right neighbour (becomes their left halo)
+    right_edge = take(local, slice(local.shape[axis] - pad, None))
+    left_halo = jax.lax.ppermute(
+        right_edge, axis_name, [(i, (i + 1) % n) for i in range(n)])
+    # my left edge goes to my left neighbour (becomes their right halo)
+    left_edge = take(local, slice(0, pad))
+    right_halo = jax.lax.ppermute(
+        left_edge, axis_name, [(i, (i - 1) % n) for i in range(n)])
+
+    if mode == "zero":
+        def bcast(cond):
+            shape = [1] * local.ndim
+            return jnp.asarray(cond).reshape(shape)
+        left_halo = jnp.where(bcast(idx == 0),
+                              jnp.zeros_like(left_halo), left_halo)
+        right_halo = jnp.where(bcast(idx == n - 1),
+                               jnp.zeros_like(right_halo), right_halo)
+    elif mode != "wrap":
+        raise ValueError("mode must be 'zero' or 'wrap', got %r" % (mode,))
+
+    return jnp.concatenate([left_halo, local, right_halo], axis=axis)

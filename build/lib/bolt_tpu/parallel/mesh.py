@@ -1,0 +1,73 @@
+"""Device-mesh construction and multi-host initialisation.
+
+This module occupies the structural slot of the reference's execution-engine
+context: where Bolt hands a ``SparkContext`` to its constructors, the TPU
+backend hands a ``jax.sharding.Mesh`` (reference call sites:
+``bolt/spark/construct.py :: ConstructSpark.array`` takes ``context``;
+see SURVEY.md §2.5 for the Spark-shuffle → ICI/DCN collective mapping).
+
+Multi-host usage keeps the single-controller programming model: after
+:func:`initialize_distributed`, a mesh built from ``jax.devices()`` spans all
+hosts and every collective rides ICI within a slice and DCN across slices,
+inserted by XLA from the sharding specs — the mesh IS the cluster.
+"""
+
+import numpy as np
+
+import jax
+
+
+def default_mesh(devices=None, axis_name="k"):
+    """A 1-d mesh over all available devices.
+
+    Every ``context=None`` TPU construction lands here, so single-chip and
+    CPU-test runs work without ceremony.
+    """
+    if devices is None:
+        devices = jax.devices()
+    return jax.sharding.Mesh(np.asarray(devices), (axis_name,))
+
+
+def make_mesh(shape, axis_names, devices=None):
+    """An n-d mesh with named axes, e.g. ``make_mesh((4, 2), ('k', 'v'))``.
+
+    Thin wrapper over ``jax.make_mesh`` so callers never import jax
+    internals; ``jax.make_mesh`` picks a device order that favours ICI
+    nearest-neighbour topology.  Axes are Auto-typed: this framework drives
+    sharding through constraints and lets GSPMD propagate.
+    """
+    if devices is not None:
+        return jax.sharding.Mesh(
+            np.asarray(devices).reshape(shape), tuple(axis_names))
+    auto = (jax.sharding.AxisType.Auto,) * len(tuple(axis_names))
+    return jax.make_mesh(tuple(shape), tuple(axis_names), axis_types=auto)
+
+
+def ensure_auto(mesh):
+    """Return an Auto-axis-typed twin of ``mesh``.
+
+    ``jax.make_mesh`` defaults to Explicit axis types in recent JAX; this
+    framework's lowering uses ``with_sharding_constraint`` + GSPMD
+    propagation, which requires Auto axes, so user-supplied meshes are
+    normalised on entry."""
+    if all(t == jax.sharding.AxisType.Auto for t in mesh.axis_types):
+        return mesh
+    return jax.sharding.Mesh(mesh.devices, mesh.axis_names)
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Initialise multi-host JAX (DCN).  No-op when already initialised or
+    running single-process.
+
+    Replaces the reference's reliance on the Spark cluster manager for
+    multi-node bring-up (SURVEY.md §2.5).
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    except (RuntimeError, ValueError):
+        # already initialised, or single-process run
+        pass

@@ -1,0 +1,86 @@
+"""Key-axis → mesh-axis assignment: the sharding spec IS the key/value split.
+
+In the reference, key axes are the RDD record-key domain spread over Spark
+partitions and value axes are the NumPy block each worker holds
+(``bolt/spark/array.py :: BoltArraySpark`` state — symbol-level citation,
+SURVEY.md §0).  Here the same split is expressed as a ``NamedSharding``: key
+axes are mapped onto mesh axes (greedily, where sizes divide), value axes are
+left unsharded/replicated.  Resharding between two such specs is what lowers
+the reference's shuffle (``swap``/``chunk``) to XLA ``all_to_all`` collective
+code over ICI.
+"""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def key_spec(mesh, shape, split):
+    """A ``PartitionSpec`` sharding the leading ``split`` key axes over the
+    mesh.
+
+    Mesh axes are assigned to key axes greedily in order: each key axis takes
+    the first unused mesh axis whose size divides it.  Unassigned axes (all
+    value axes, and key axes nothing divides) are replicated — the exact
+    analog of the reference's "records spread over partitions, block local to
+    a worker".
+    """
+    spec = [None] * len(shape)
+    if mesh is not None:
+        used = set()
+        for i in range(split):
+            for name in mesh.axis_names:
+                if name in used or mesh.shape[name] <= 1:
+                    continue
+                if shape[i] % mesh.shape[name] == 0:
+                    spec[i] = name
+                    used.add(name)
+                    break
+    return P(*spec)
+
+
+def combined_spec(mesh, shape, split, value_axes=None):
+    """:func:`key_spec` plus explicit value-axis → mesh-axis assignments.
+
+    ``value_axes`` maps a value-axis index (relative to the value group) to
+    a mesh axis name — the sequence/context-parallel analog: the long
+    contiguous dimension itself is split across devices (the reference
+    scales such axes past one worker's memory with ``ChunkedArray`` blocks;
+    SURVEY §2.4 maps that to value-axis sharding on the mesh)."""
+    spec = list(key_spec(mesh, shape, split))
+    if value_axes:
+        used = {s for s in spec if s is not None}
+        for va, name in value_axes.items():
+            ax = split + va
+            if ax < split or ax >= len(shape):
+                raise ValueError("value axis %d out of range" % (va,))
+            if name not in mesh.axis_names:
+                raise ValueError("unknown mesh axis %r" % (name,))
+            if name in used:
+                raise ValueError("mesh axis %r already assigned" % (name,))
+            if shape[ax] % mesh.shape[name] != 0:
+                raise ValueError(
+                    "value axis %d (size %d) is not divisible by mesh axis "
+                    "%r (size %d)" % (va, shape[ax], name, mesh.shape[name]))
+            spec[ax] = name
+            used.add(name)
+    return P(*spec)
+
+
+def key_sharding(mesh, shape, split):
+    """``NamedSharding`` for a bolt array of ``shape`` with ``split`` leading
+    key axes (see :func:`key_spec`)."""
+    return NamedSharding(mesh, key_spec(mesh, shape, split))
+
+
+def reshard(data, mesh, split):
+    """Place ``data`` according to the key sharding for ``split``.
+
+    Outside jit this is ``jax.device_put`` (XLA inserts the collective —
+    all_to_all/all_gather — that the reference performs as a Spark shuffle;
+    SURVEY.md §2.5 lowering contract)."""
+    return jax.device_put(data, key_sharding(mesh, data.shape, split))
+
+
+def is_mesh(obj):
+    """Dispatch predicate: is ``obj`` a device-mesh context?"""
+    return isinstance(obj, Mesh)

@@ -1,0 +1,87 @@
+"""Tracing, timing and debug instrumentation.
+
+The reference ships NO in-repo tracing/profiling — users fall back to the
+Spark UI and JVM metrics (SURVEY §5).  The TPU stack does better for free:
+``jax.profiler`` captures device traces viewable in TensorBoard/Perfetto,
+and XLA programs have precise completion semantics, so wall-clock and GB/s
+numbers are meaningful.  This module packages that:
+
+* :func:`trace` — context manager writing a device trace to a log dir.
+* :func:`annotate` — names a region so it shows up in the trace timeline.
+* :func:`timeit` — robust wall-clock of a function over device arrays,
+  fetching results to force completion (NOTE: fetching, not
+  ``block_until_ready``, is the reliable barrier on remote-attached
+  devices).
+* :func:`throughput` — GB/s given bytes touched, the BASELINE "GB/s/chip"
+  metric.
+* :func:`debug_nans` — toggles jax NaN checking (the race-detector slot in
+  SURVEY §5: SPMD is race-free by construction; numeric poison is the
+  practical hazard, so that's what debug mode checks).
+"""
+
+import time
+
+import numpy as np
+
+import jax
+
+
+def trace(logdir):
+    """Device-trace context manager::
+
+        with bolt_tpu.profile.trace("/tmp/trace"):
+            b.map(f).sum().toarray()
+
+    View with TensorBoard's profile plugin or Perfetto."""
+    return jax.profiler.trace(logdir)
+
+
+def annotate(name):
+    """Name a region in the device trace timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def timeit(fn, iters=5, warmup=1):
+    """``(result, best_seconds)`` for ``fn()`` over ``iters`` timed runs.
+
+    The result is pulled to the host each run (``jax.device_get``) so the
+    timing includes real completion — on remote-attached devices,
+    ``block_until_ready`` alone can return before execution finishes.
+    """
+    result = None
+    for _ in range(max(warmup, 0)):
+        result = jax.device_get(fn())
+    best = float("inf")
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
+        result = jax.device_get(fn())
+        best = min(best, time.perf_counter() - t0)
+    return result, best
+
+
+def throughput(nbytes, seconds):
+    """GB/s for ``nbytes`` touched in ``seconds`` (the BASELINE
+    "GB/s/chip" metric when run single-chip)."""
+    return nbytes / 1e9 / seconds
+
+
+def array_bytes(barray):
+    """Logical payload bytes of a bolt array."""
+    return int(np.prod(barray.shape, dtype=np.int64)) * barray.dtype.itemsize
+
+
+def debug_nans(enable=True):
+    """Toggle jax's NaN checking for all subsequently compiled programs."""
+    jax.config.update("jax_debug_nans", bool(enable))
+
+
+def memory_stats(device=None):
+    """Per-device memory counters (HBM on TPU) as a dict, or ``{}`` where
+    the backend doesn't expose them.  Keys follow the PJRT convention
+    (``bytes_in_use``, ``bytes_limit``, ``peak_bytes_in_use``, ...)."""
+    d = device if device is not None else jax.local_devices()[0]
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        return {}
+    return dict(stats) if stats else {}

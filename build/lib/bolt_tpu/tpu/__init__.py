@@ -1,0 +1,4 @@
+from bolt_tpu.tpu.array import BoltArrayTPU
+from bolt_tpu.tpu.construct import ConstructTPU
+
+__all__ = ["BoltArrayTPU", "ConstructTPU"]

@@ -1,0 +1,110 @@
+"""Stacking: batching flat key records into blocks.
+
+Reference: ``bolt/spark/stack.py :: StackedArray`` — ``_stack(size)`` groups
+consecutive records' values into one ``(n, *value_shape)`` block per
+partition so a user function hits BLAS once per block instead of once per
+record; ``map`` operates on blocks, ``unstack`` restores records
+(symbol-level citations, SURVEY.md §0).
+
+On TPU the batching the reference buys with this machinery is native — every
+``map`` is already one fused vectorised launch — so ``StackedArray`` is a
+thin compatibility view: it exposes the same block-wise ``map`` contract
+(``func`` sees ``(n, *value_shape)`` and must preserve ``n``), executing all
+blocks in one compiled program.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit, _constrain, _traceable
+from bolt_tpu.utils import prod
+
+
+class StackedArray:
+    """A block-batched view over a :class:`BoltArrayTPU`."""
+
+    def __init__(self, barray, size):
+        self._barray = barray
+        self._size = int(size)
+
+    @classmethod
+    def stack(cls, barray, size=1000):
+        if int(size) < 1:
+            raise ValueError("stack size must be >= 1, got %r" % (size,))
+        return cls(barray, size)
+
+    @property
+    def shape(self):
+        return self._barray.shape
+
+    @property
+    def split(self):
+        return self._barray.split
+
+    @property
+    def dtype(self):
+        return self._barray.dtype
+
+    @property
+    def mode(self):
+        return "tpu"
+
+    @property
+    def size(self):
+        """Records per block (reference: the ``_stack(size)`` argument)."""
+        return self._size
+
+    @property
+    def nblocks(self):
+        n = prod(self.shape[:self.split])
+        return -(-n // self._size)
+
+    def map(self, func, value_shape=None, dtype=None):
+        """Apply ``func`` block-wise: it receives ``(n, *value_shape)`` and
+        must return ``(n, *new_value_shape)`` — record counts are preserved,
+        as the reference requires for ``unstack`` to restore keys.  All
+        blocks run in one compiled program (static block boundaries; the
+        ragged tail block is its own trace)."""
+        func = _traceable(func)
+        b = self._barray
+        split = b.split
+        mesh = b.mesh
+        kshape = b.shape[:split]
+        vshape = b.shape[split:]
+        n = prod(kshape)
+        size = self._size
+
+        def build():
+            def run(data):
+                flat = data.reshape((n,) + vshape)
+                outs = []
+                for i in range(0, n, size):
+                    blk = flat[i:min(i + size, n)]
+                    out = func(blk)
+                    if out.shape[0] != blk.shape[0]:
+                        raise ValueError(
+                            "stacked map must preserve the record count: "
+                            "block of %d records -> %d" % (blk.shape[0], out.shape[0]))
+                    outs.append(out)
+                out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+                out = out.reshape(kshape + out.shape[1:])
+                return _constrain(out, mesh, split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("stack-map", func, b.shape, str(b.dtype), split,
+                          size, mesh), build)
+        return StackedArray(BoltArrayTPU(fn(b._data), split, mesh), size)
+
+    def unstack(self):
+        """Back to a :class:`BoltArrayTPU` (reference:
+        ``StackedArray.unstack``); a no-op unwrap here."""
+        return self._barray
+
+    def __repr__(self):
+        s = "StackedArray\n"
+        s += "mode: tpu\n"
+        s += "shape: %s\n" % str(self.shape)
+        s += "split: %d\n" % self.split
+        s += "size: %d\n" % self._size
+        s += "nblocks: %d\n" % self.nblocks
+        return s

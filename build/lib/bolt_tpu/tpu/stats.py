@@ -1,0 +1,90 @@
+"""Explicit-collective streaming statistics for the TPU backend.
+
+Reference: the ``rdd.aggregate(StatCounter(), merge, mergeStats)`` path
+behind ``BoltArraySpark.stats/_stat`` (SURVEY §3.4): per-partition Welford
+accumulation in Python workers, tree-combined across the cluster.  Here each
+mesh shard computes its local moments on-device and the Chan combine is a
+handful of ``psum``/``pmax``/``pmin`` collectives over the ICI — one
+compiled ``shard_map`` program, no host involvement until the final scalar
+fetch.
+
+This module is the framework's canonical example of the explicit-collective
+(``shard_map``) style; the everyday ``mean()/var()/std()`` methods use plain
+``jnp`` reductions and let GSPMD insert the same collectives automatically.
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from bolt_tpu.parallel.sharding import key_spec
+from bolt_tpu.statcounter import StatCounter
+from bolt_tpu.utils import prod, tupleize
+
+_WELFORD_CACHE = {}
+
+
+def welford(barray, requested=("mean", "var", "std", "min", "max"),
+            axis=None):
+    """Single-pass count/mean/var/std/min/max over key axes, returned as a
+    :class:`~bolt_tpu.statcounter.StatCounter` holding value-shaped moments.
+
+    ``axis=None`` reduces over all key axes (the reference's
+    ``stats()``).  A subset of key axes is allowed; the result then keeps
+    the remaining key axes as leading dimensions of each moment.
+    """
+    split = barray.split
+    if axis is None:
+        axes = tuple(range(split))
+    else:
+        axes = tuple(sorted(tupleize(axis)))
+        for a in axes:
+            if a < 0 or a >= split:
+                raise ValueError(
+                    "stats axis %d is not a key axis (split=%d)" % (a, split))
+    if len(axes) == 0:
+        raise ValueError("at least one key axis is required")
+
+    mesh = barray.mesh
+    shape = barray.shape
+    spec = tuple(key_spec(mesh, shape, split))
+    # mesh axes assigned to the reduced dims participate in the collectives
+    reduce_names = tuple(spec[a] for a in axes if spec[a] is not None)
+    out_spec = P(*(spec[i] for i in range(len(shape)) if i not in axes))
+    n_total = prod(tuple(shape[a] for a in axes))
+
+    key = ("welford", shape, str(barray.dtype), axes, spec, mesh)
+    fn = _WELFORD_CACHE.get(key)
+    if fn is None:
+        def local_moments(x):
+            # x is the per-device shard; reduced dims may be divided across
+            # the mesh, so this count is the LOCAL n.
+            n_local = prod(tuple(x.shape[a] for a in axes))
+            mu = jnp.mean(x, axis=axes)
+            m2 = jnp.sum((x - jnp.mean(x, axis=axes, keepdims=True)) ** 2,
+                         axis=axes)
+            mx = jnp.max(x, axis=axes)
+            mn = jnp.min(x, axis=axes)
+            if reduce_names:
+                n_loc = jnp.asarray(n_local, dtype=mu.dtype)
+                n_tot = jax.lax.psum(n_loc, reduce_names)
+                grand = jax.lax.psum(mu * n_loc, reduce_names) / n_tot
+                # Chan et al.: total M2 = sum M2_i + sum n_i (mu_i - grand)^2
+                m2 = jax.lax.psum(m2 + n_loc * (mu - grand) ** 2, reduce_names)
+                mu = grand
+                mx = jax.lax.pmax(mx, reduce_names)
+                mn = jax.lax.pmin(mn, reduce_names)
+            return mu, m2, mn, mx
+
+        fn = jax.jit(jax.shard_map(
+            local_moments, mesh=mesh, in_specs=P(*spec),
+            out_specs=(out_spec, out_spec, out_spec, out_spec)))
+        _WELFORD_CACHE[key] = fn
+
+    mu, m2, mn, mx = (np.asarray(jax.device_get(o)) for o in fn(barray._data))
+    return StatCounter.from_moments(n_total, mu, m2, minValue=mn, maxValue=mx,
+                                    stats=requested)

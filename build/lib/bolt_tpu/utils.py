@@ -1,0 +1,174 @@
+"""Shared argument-normalisation helpers and test utilities.
+
+Reference parity: ``bolt/utils.py`` — symbols ``tupleize``, ``listify``,
+``argpack``, ``inshape``, ``slicify``, ``allclose``, ``iterexpand``,
+``istransposeable``, ``isreshapeable``.  (Symbol-level citations only: the
+reference mount was empty this round — see SURVEY.md §0.)
+"""
+
+from numbers import Integral
+
+import numpy as np
+
+
+def tupleize(arg):
+    """Coerce an argument to a tuple.
+
+    Scalars become 1-tuples; lists/ranges/ndarrays become tuples; a 1-tuple
+    wrapping a tuple/list (as produced by ``f(*args)`` with ``f((0, 1))``)
+    is unwrapped.  ``None`` passes through.
+
+    Reference: ``bolt/utils.py :: tupleize``.
+    """
+    if arg is None:
+        return None
+    if isinstance(arg, (tuple, list, range, np.ndarray)):
+        if isinstance(arg, tuple) and len(arg) == 1 and isinstance(arg[0], (tuple, list, range, np.ndarray)):
+            return tuple(arg[0])
+        return tuple(arg)
+    return (arg,)
+
+
+def listify(arg):
+    """Like :func:`tupleize` but returns a list.
+
+    Reference: ``bolt/utils.py :: listify``.
+    """
+    t = tupleize(arg)
+    return None if t is None else list(t)
+
+
+def argpack(args):
+    """Normalise ``*args``-style shape/axis arguments.
+
+    Supports both ``f(1, 2, 3)`` and ``f((1, 2, 3))`` calling conventions.
+
+    Reference: ``bolt/utils.py :: argpack``.
+    """
+    if len(args) == 1 and isinstance(args[0], (tuple, list, range, np.ndarray)):
+        return tuple(args[0])
+    return tuple(args)
+
+
+def inshape(shape, axes):
+    """Validate that every axis index is within ``range(len(shape))``.
+
+    Reference: ``bolt/utils.py :: inshape``.
+    """
+    ndim = len(shape)
+    for a in tupleize(axes):
+        if not isinstance(a, Integral):
+            raise ValueError("axis %r is not an integer" % (a,))
+        if a < 0 or a >= ndim:
+            raise ValueError(
+                "axis %d out of bounds for array with %d dimensions" % (a, ndim))
+
+
+def iterexpand(arg, n):
+    """Broadcast a scalar to an ``n``-tuple, or validate an ``n``-sequence.
+
+    Reference: ``bolt/utils.py :: iterexpand``.
+    """
+    if isinstance(arg, (tuple, list, np.ndarray)):
+        t = tuple(arg)
+        if len(t) != n:
+            raise ValueError(
+                "sequence of length %d cannot be broadcast to length %d" % (len(t), n))
+        return t
+    return (arg,) * n
+
+
+def slicify(slc, dim):
+    """Normalise a single-axis index to a canonical form.
+
+    * ``slice`` → ``slice`` with concrete, in-bounds ``start/stop/step``
+    * integer → ``slice(i, i+1, 1)`` (negative values wrapped); the caller is
+      responsible for tracking the implied dimension squeeze
+    * list / integer ndarray → 1-d ``np.ndarray`` of wrapped, validated indices
+    * boolean ndarray of length ``dim`` → ``np.ndarray`` of selected indices
+
+    Reference: ``bolt/utils.py :: slicify``.
+    """
+    if isinstance(slc, slice):
+        start, stop, step = slc.indices(dim)
+        if step < 0 and stop < 0:
+            # a computed stop of -1 means "past the beginning"; keep it None
+            # so downstream indexing doesn't wrap it to dim-1
+            stop = None
+        return slice(start, stop, step)
+    if isinstance(slc, (Integral, np.integer)):
+        i = int(slc)
+        if i < 0:
+            i += dim
+        if i < 0 or i >= dim:
+            raise IndexError("index %d out of bounds for axis of size %d" % (int(slc), dim))
+        return slice(i, i + 1, 1)
+    if isinstance(slc, (list, tuple, np.ndarray)):
+        arr = np.asarray(slc)
+        if arr.dtype == bool:
+            if arr.ndim != 1 or arr.shape[0] != dim:
+                raise IndexError(
+                    "boolean index of shape %s does not match axis of size %d" % (arr.shape, dim))
+            return np.nonzero(arr)[0]
+        arr = arr.astype(np.int64)
+        arr = np.where(arr < 0, arr + dim, arr)
+        if arr.size and (arr.min() < 0 or arr.max() >= dim):
+            raise IndexError("index out of bounds for axis of size %d" % dim)
+        return arr
+    raise ValueError("cannot index axis with %r" % (slc,))
+
+
+def istransposeable(new, old):
+    """True if ``new`` is a permutation of the axes ``old``.
+
+    Reference: ``bolt/utils.py :: istransposeable``.
+    """
+    new, old = tupleize(new), tupleize(old)
+    return sorted(new) == sorted(old)
+
+
+def isreshapeable(new, old):
+    """True if shape ``new`` has the same number of elements as ``old``.
+
+    Reference: ``bolt/utils.py :: isreshapeable``.
+    """
+    new, old = tupleize(new), tupleize(old)
+    return int(np.prod(new, dtype=np.int64)) == int(np.prod(old, dtype=np.int64))
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8):
+    """Shape-and-value comparison used throughout the test suite.
+
+    Reference: ``bolt/utils.py :: allclose`` (shape equality + ``np.allclose``).
+    """
+    a = np.asarray(a)
+    b = np.asarray(b)
+    return a.shape == b.shape and np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def prod(shape):
+    """Integer product of a shape tuple (1 for the empty shape)."""
+    return int(np.prod(tupleize(shape) or (1,), dtype=np.int64))
+
+
+def get_kv_axes(shape, axes):
+    """Split the axis indices of ``shape`` into (key axes, value axes),
+    key axes being those named in ``axes``.
+
+    Reference: ``bolt/spark/utils.py :: get_kv_axes``.
+    """
+    axes = sorted(tupleize(axes))
+    inshape(shape, axes)
+    kaxes = tuple(axes)
+    vaxes = tuple(i for i in range(len(shape)) if i not in axes)
+    return kaxes, vaxes
+
+
+def get_kv_shape(shape, axes):
+    """Split ``shape`` into (key shape, value shape) for the key axes
+    ``axes``.
+
+    Reference: ``bolt/spark/utils.py :: get_kv_shape``.
+    """
+    kaxes, vaxes = get_kv_axes(shape, axes)
+    return (tuple(shape[a] for a in kaxes), tuple(shape[a] for a in vaxes))
