@@ -59,6 +59,17 @@ _JIT_CACHE_MAX = 512
 # stable callables for scalar operator operands (see _scalar_fn)
 _SCALAR_FN_CACHE = OrderedDict()
 
+# toarray's batched pending-filter fetch ships the FULL padded buffer to
+# save one round-trip; above this size the worst case (few survivors) costs
+# more in transfer than the round-trip saves, so resolve first instead
+_PENDING_FETCH_MAX_BYTES = 32 << 20
+
+# the fused (lazy-count) filter materialises an n-row padded compaction
+# buffer — a full-size transient copy.  Above this input size that copy
+# threatens HBM (a 10 GB filter would need 20 GB); fall back to the
+# two-phase path whose gather output is only survivor-count rows
+_FILTER_FUSED_MAX_BYTES = 1 << 30
+
 
 def _cached_jit(key, builder):
     fn = _JIT_CACHE.get(key)
@@ -133,6 +144,10 @@ class BoltArrayTPU(BoltArray):
         self._mesh = mesh
         # deferred map chain: (base jax.Array, (func, ...)) or None
         self._chain = None
+        # pending dynamic-shape result: (padded jax.Array, count device
+        # scalar) from filter() — the survivor count has not been read on
+        # host yet, so the logical shape is not known (see filter())
+        self._pending = None
         self._donated = False
         self._aval = None if data is None else jax.ShapeDtypeStruct(
             data.shape, data.dtype)
@@ -150,10 +165,15 @@ class BoltArrayTPU(BoltArray):
 
     @property
     def shape(self):
+        if self._pending is not None:
+            self._resolve_pending()
         return tuple(self._aval.shape)
 
     @property
     def dtype(self):
+        if self._pending is not None:
+            # dtype is known without syncing the survivor count
+            return np.dtype(self._pending[0].dtype)
         return np.dtype(self._aval.dtype)
 
     @property
@@ -172,6 +192,40 @@ class BoltArrayTPU(BoltArray):
         return self._concrete is None and self._chain is not None
 
     @property
+    def pending(self):
+        """True while this array is an unresolved dynamic-shape result (a
+        ``filter`` whose survivor count has not been synced to host): the
+        compacted data lives on device, but the logical shape is unknown
+        until one scalar fetch.  Reading ``shape`` (or any consumer)
+        resolves it; ``toarray`` resolves it with a single batched
+        transfer."""
+        return self._pending is not None
+
+    def _resolve_pending(self, count=None):
+        """Slice the padded on-device buffer down to the true
+        ``(n, *value_shape)``; syncs the survivor count (one scalar host
+        fetch) unless the caller already knows it."""
+        if self._pending is None:
+            return
+        padded, cnt = self._pending
+        if count is None:
+            count = int(jax.device_get(cnt))
+        mesh = self._mesh
+
+        def build():
+            def sl(p):
+                out = jax.lax.slice_in_dim(p, 0, count, axis=0)
+                return _constrain(out, mesh, 1)
+            return jax.jit(sl)
+
+        fn = _cached_jit(("filter-slice", padded.shape, str(padded.dtype),
+                          count, mesh), build)
+        self._concrete = fn(padded)
+        self._aval = jax.ShapeDtypeStruct(self._concrete.shape,
+                                          self._concrete.dtype)
+        self._pending = None
+
+    @property
     def _data(self):
         """The concrete sharded ``jax.Array``; materialises a deferred
         chain on first access (one fused compiled program)."""
@@ -179,6 +233,8 @@ class BoltArrayTPU(BoltArray):
             raise RuntimeError(
                 "this array's device buffer was donated to a swap(...,"
                 " donate=True); it can no longer be read")
+        if self._pending is not None:
+            self._resolve_pending()
         if self._concrete is None:
             base, funcs = self._chain
             mesh, split = self._mesh, self._split
@@ -193,6 +249,12 @@ class BoltArrayTPU(BoltArray):
             self._concrete = fn(_check_live(base))
             self._chain = None
         return _check_live(self._concrete)
+
+    def _chain_parts(self):
+        """``(base jax.Array, funcs)`` for fusing this array into a bigger
+        program: the unmaterialised chain if deferred, else the concrete
+        data with an empty chain."""
+        return self._chain if self.deferred else (self._data, ())
 
     @property
     def keys(self):
@@ -323,13 +385,26 @@ class BoltArrayTPU(BoltArray):
         return self._wrap(out, split)
 
     def filter(self, func, axis=(0,), sort=False):
-        """Two-phase dynamic-shape filter: (1) a compiled vmapped predicate
-        produces a mask; (2) one host sync reads the survivor indices and a
-        compiled gather compacts them into a ``(n, *value_shape)`` array with
-        ``split=1`` — mirroring the reference's re-key-to-linear semantics
-        (``BoltArraySpark.filter``) while paying the same single host
-        round-trip the reference pays for shape inference (SURVEY §7 hard
-        part 1).  ``sort`` is accepted for parity; output is always ordered.
+        """Dynamic-shape filter, fully on device: ONE fused compiled program
+        applies any deferred map chain, evaluates the vmapped predicate,
+        stably compacts the surviving records to the front of a padded
+        ``(nkeys, *value_shape)`` buffer, and counts them — all without
+        leaving the device.  The result is returned immediately in a
+        *pending* state: the survivor count (the only thing XLA's static
+        shapes cannot express) is synced lazily — one scalar fetch when the
+        shape is first needed, or batched into ``toarray``'s transfer so a
+        ``filter(...).toarray()`` pipeline pays a single host round-trip.
+
+        Output records are re-keyed to a flat ``(n,)`` key space with
+        ``split=1`` in original key order — the reference's re-key-to-linear
+        semantics (``BoltArraySpark.filter``); the reference pays a Spark
+        job at the same spot for shape inference (SURVEY §7 hard part 1).
+        ``sort`` is accepted for parity; output is always ordered.
+
+        The fused path's padded compaction buffer is a full-size transient
+        copy; above ``_FILTER_FUSED_MAX_BYTES`` (HBM-scale inputs) the
+        two-phase mask→count→gather path runs instead, whose output is
+        survivor-count rows only.
         """
         func = _traceable(func)
         axes = sorted(tupleize(axis))
@@ -356,14 +431,53 @@ class BoltArrayTPU(BoltArray):
                 "record; got shape %s for value shape %s"
                 % (tuple(pred_aval.shape), vshape))
 
+        nbytes = n * prod(vshape) * np.dtype(aligned._aval.dtype).itemsize
+        if nbytes > _FILTER_FUSED_MAX_BYTES:
+            # the padded compaction buffer would be a full-size HBM copy;
+            # take the memory-safe two-phase path (its gather output is
+            # survivor-count rows only) at the cost of an eager count sync
+            return self._filter_eager(func, aligned, split, vshape, n, mesh)
+
+        base, funcs = aligned._chain_parts()
+
+        def build():
+            def fused(data):
+                mapped = _chain_apply(funcs, split, data)
+                flat = mapped.reshape((n,) + vshape)
+                mask = jax.vmap(
+                    lambda v: jnp.asarray(func(v), dtype=bool).reshape(()))(flat)
+                # survivor indices in increasing (key) order, padded with 0s
+                # beyond the count — rows past the count are garbage and are
+                # sliced away at resolution
+                perm = jnp.nonzero(mask, size=n, fill_value=0)[0]
+                padded = jnp.take(flat, perm, axis=0)
+                return (_constrain(padded, mesh, 1),
+                        jnp.sum(mask, dtype=jnp.int32))
+            return jax.jit(fused)
+
+        fn = _cached_jit(("filter-fused", func, funcs, base.shape,
+                          str(base.dtype), split, mesh), build)
+        padded, cnt = fn(_check_live(base))
+        out = BoltArrayTPU(None, 1, mesh)
+        out._pending = (padded, cnt)
+        return out
+
+    def _filter_eager(self, func, aligned, split, vshape, n, mesh):
+        """Two-phase filter for inputs too large for a padded compaction
+        copy: compiled mask → host count sync → compiled gather whose
+        output is exactly ``(count, *value_shape)`` — peak HBM is input +
+        survivors, never 2× input."""
+
         def build():
             def masker(data):
                 flat = data.reshape((n,) + vshape)
-                return jax.vmap(lambda v: jnp.asarray(func(v), dtype=bool).reshape(()))(flat)
+                return jax.vmap(
+                    lambda v: jnp.asarray(func(v), dtype=bool).reshape(()))(flat)
             return jax.jit(masker)
 
         mask = _cached_jit(("filter-mask", func, aligned.shape,
-                            str(aligned.dtype), split, mesh), build)(aligned._data)
+                            str(aligned.dtype), split, mesh),
+                           build)(aligned._data)
         idx = np.nonzero(np.asarray(jax.device_get(mask)))[0]
 
         def gather_build():
@@ -408,8 +522,7 @@ class BoltArrayTPU(BoltArray):
                 key_sharding(mesh, out.shape, new_split))
             return self._wrap(data, new_split)
 
-        base, funcs = (aligned._chain if aligned.deferred
-                       else (aligned._data, ()))
+        base, funcs = aligned._chain_parts()
 
         def build():
             def reducer(data):
@@ -452,7 +565,7 @@ class BoltArrayTPU(BoltArray):
         nkeys_reduced = sum(1 for a in axes if a < split)
         new_split = split if keepdims else split - nkeys_reduced
 
-        base, funcs = (self._chain if self.deferred else (self._data, ()))
+        base, funcs = self._chain_parts()
 
         def build():
             op = {"mean": jnp.mean, "var": jnp.var, "std": jnp.std,
@@ -929,7 +1042,26 @@ class BoltArrayTPU(BoltArray):
         ``BoltArraySpark.toarray`` = sortByKey → collect → reshape; here a
         single ``device_get`` — ordering is intrinsic, SURVEY §3.5).  On a
         multi-host mesh, shards the local process cannot address are
-        all-gathered over DCN first."""
+        all-gathered over DCN first.
+
+        A small pending ``filter`` result is fetched in ONE batched
+        transfer (padded buffer + survivor count together) and sliced on
+        host, so ``filter(...).toarray()`` pays a single round-trip instead
+        of a count sync followed by a data fetch; the fetched count then
+        resolves the device side for free.  Large padded buffers skip the
+        fast path — when few records survive, shipping the full buffer
+        would cost more than the extra count round-trip saves."""
+        if self._pending is not None:
+            padded, cnt = self._pending
+            if (padded.is_fully_addressable
+                    and padded.size * padded.dtype.itemsize
+                    <= _PENDING_FETCH_MAX_BYTES):
+                p, c = jax.device_get((padded, cnt))
+                out = np.asarray(p)[:int(c)].copy()
+                # the count is on host now: resolve device-side without a
+                # second sync, releasing the padded buffer
+                self._resolve_pending(count=int(c))
+                return out
         data = self._data
         if not data.is_fully_addressable:
             from jax.experimental import multihost_utils
@@ -1025,13 +1157,20 @@ class BoltArrayTPU(BoltArray):
     def __repr__(self):
         s = "BoltArray\n"
         s += "mode: %s\n" % self.mode
-        s += "shape: %s\n" % str(self.shape)
+        if self._pending is not None:
+            # don't force the count sync just to print; show what is known
+            s += "shape: (%s)\n" % ", ".join(
+                ["?"] + [str(d) for d in self._pending[0].shape[1:]])
+        else:
+            s += "shape: %s\n" % str(self.shape)
         s += "split: %d\n" % self._split
         s += "dtype: %s\n" % str(self.dtype)
         if self._donated:
             s += "donated: buffer consumed by swap(donate=True)\n"
         elif self.deferred:
             s += "deferred: %d-op map chain\n" % len(self._chain[1])
+        elif self._pending is not None:
+            s += "pending: filter count not yet synced\n"
         else:
             try:
                 s += "sharding: %s\n" % str(self._concrete.sharding.spec)

@@ -100,3 +100,110 @@ def test_reduce_single_record(mesh):
     x = np.ones((1, 3))
     b = bolt.array(x, mesh)
     assert allclose(b.reduce(add).toarray(), x.sum(axis=0))
+
+
+# ----------------------------------------------------------------------
+# pending (lazy-count) filter semantics: the survivor count syncs to host
+# only when the shape is needed, and toarray batches it with the data fetch
+# ----------------------------------------------------------------------
+
+def test_filter_is_pending_until_shape_read(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.filter(lambda v: v.sum() > 0)
+    assert out.pending
+    expected = np.asarray([v for v in x if v.sum() > 0])
+    assert out.shape == expected.shape        # resolves: one scalar sync
+    assert not out.pending
+    assert allclose(out.toarray(), expected)
+
+
+def test_filter_toarray_without_prior_resolution(mesh):
+    # the batched-fetch fast path: toarray on a still-pending result
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.filter(lambda v: v[0, 0] > 0)
+    assert out.pending
+    expected = np.asarray([v for v in x if v[0, 0] > 0])
+    assert allclose(out.toarray(), expected)
+    # the fetched count resolves the device side as a side effect, so later
+    # consumers pay neither a re-transfer nor a count sync
+    assert not out.pending
+    assert allclose(out.toarray(), expected)
+    assert out.split == 1
+
+
+def test_filter_repr_does_not_sync(mesh):
+    x = _x()
+    out = bolt.array(x, mesh).filter(lambda v: v.sum() > 0)
+    r = repr(out)
+    assert "pending" in r
+    assert out.pending  # repr must not have forced the count sync
+
+
+def test_filter_dtype_known_while_pending(mesh):
+    x = _x()
+    out = bolt.array(x, mesh).filter(lambda v: v.sum() > 0)
+    assert out.dtype == x.dtype
+    assert out.pending
+
+
+def test_filter_fuses_deferred_chain(mesh):
+    # map defers; filter consumes the chain inside its own fused program
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.map(lambda v: v * 2).map(lambda v: v - 1).filter(
+        lambda v: v.sum() > -20)
+    y = x * 2 - 1
+    expected = np.asarray([v for v in y if v.sum() > -20])
+    assert expected.shape[0] not in (0, x.shape[0])  # a real subset
+    assert allclose(out.toarray(), expected)
+
+
+def test_filter_empty_and_full(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    none = b.filter(lambda v: v.sum() > 1e9)
+    assert none.shape == (0,) + x.shape[1:]
+    assert none.toarray().shape == (0,) + x.shape[1:]
+    everything = b.filter(lambda v: v.sum() > -1e9)
+    assert allclose(everything.toarray(), x)
+
+
+def test_filter_chains_into_map(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.filter(lambda v: v.sum() > 0).map(lambda v: v + 1)
+    expected = np.asarray([v + 1 for v in x if v.sum() > 0])
+    assert allclose(out.toarray(), expected)
+
+
+def test_filter_toarray_large_buffer_path(mesh, monkeypatch):
+    # above the batched-fetch size cap, toarray resolves first (scalar
+    # count sync + sliced fetch) instead of shipping the padded buffer
+    import bolt_tpu.tpu.array as mod
+    monkeypatch.setattr(mod, "_PENDING_FETCH_MAX_BYTES", 0)
+    x = _x()
+    out = bolt.array(x, mesh).filter(lambda v: v.sum() > 0)
+    expected = np.asarray([v for v in x if v.sum() > 0])
+    assert allclose(out.toarray(), expected)
+    assert not out.pending
+
+
+def test_filter_eager_path_for_large_inputs(mesh, monkeypatch):
+    # above the fused-path size cap, filter takes the memory-safe
+    # two-phase route: eager count sync, survivor-sized gather output
+    import bolt_tpu.tpu.array as mod
+    monkeypatch.setattr(mod, "_FILTER_FUSED_MAX_BYTES", 0)
+    x = _x()
+    b = bolt.array(x, mesh)
+    out = b.filter(lambda v: v.sum() > 0)
+    assert not out.pending  # eager path resolves immediately
+    expected = np.asarray([v for v in x if v.sum() > 0])
+    assert allclose(out.toarray(), expected)
+    assert out.split == 1
+    # value-axis filter goes through _align then the same path
+    out2 = b.filter(lambda v: v[0, 0] > 0, axis=(1,))
+    exp2 = np.asarray([x[:, i, :] for i in range(x.shape[1])
+                       if x[0, i, 0] > 0])
+    assert allclose(out2.toarray(), exp2)
