@@ -184,67 +184,4 @@ def fused_stats(x, interpret=None):
             mn[0, 0].astype(x.dtype), mx[0, 0].astype(x.dtype))
 
 
-def _adjoint(x):
-    """Conjugate transpose of the trailing two dims (plain transpose for
-    real dtypes)."""
-    xt = jnp.swapaxes(x, -1, -2)
-    return jnp.conj(xt) if jnp.iscomplexobj(x) else xt
-
-
-def _acc_dtype(dtype):
-    """Accumulation dtype for the Gram matmul: widen half precisions to
-    float32, never narrow (jax rejects a narrower preferred_element_type)."""
-    if dtype in (jnp.bfloat16, jnp.float16):
-        return jnp.float32
-    return dtype
-
-
-def _real_dtype(dtype):
-    return jnp.finfo(dtype).dtype if jnp.issubdtype(dtype, jnp.complexfloating) \
-        else dtype
-
-
-def svdvals(x, gram_ratio=4):
-    """Singular values of a (possibly batched) matrix, TPU-first.
-
-    For tall-skinny blocks (rows >= ``gram_ratio`` * cols) — the shape of
-    the reference's PCA workload (``BASELINE`` config 5: per-chunk SVD on
-    ``(N, features)``) — the values come from the Gram matrix:
-    ``sqrt(eigvalsh(x.T @ x))``.  The matmul runs on the MXU and the
-    eigendecomposition touches only a (cols, cols) matrix, instead of
-    XLA's QR-iteration SVD over the full block.  The trade-off is the
-    classic one: forming the Gram matrix squares the condition number, so
-    trailing singular values below ``sqrt(eps) * s_max`` lose accuracy —
-    fine for PCA-style spectra, not for rank-revealing use.  Wide or
-    near-square inputs fall back to ``jnp.linalg.svd``.
-    """
-    rows, cols = x.shape[-2], x.shape[-1]
-    if rows >= gram_ratio * cols:
-        g = jnp.matmul(_adjoint(x), x,
-                       preferred_element_type=_acc_dtype(x.dtype))
-        ev = jnp.linalg.eigvalsh(g)                    # ascending, real
-        ev = jnp.maximum(ev[..., ::-1], 0.0)           # descending, clamped
-        return jnp.sqrt(ev).astype(_real_dtype(x.dtype))
-    return jnp.linalg.svd(x, compute_uv=False)
-
-
-def tallskinny_pca(x, k=None):
-    """Principal components of a tall-skinny ``(n, d)`` matrix via the
-    Gram route: eigendecompose ``x.T @ x`` (d x d, MXU matmul), return
-    ``(components (d, k), singular_values (k,))`` in descending order.
-    The reference runs this workload as per-chunk SVD through Spark
-    (``BASELINE`` config 5); here the big matmul is the only pass over
-    the data."""
-    n, d = x.shape
-    if n < d:
-        raise ValueError(
-            "tallskinny_pca requires n >= d (got %d x %d): the rank-%d Gram "
-            "matrix would pad the spectrum with zero eigenvalues whose "
-            "eigenvectors are arbitrary; use jnp.linalg.svd" % (n, d, n))
-    g = jnp.matmul(_adjoint(x), x, preferred_element_type=_acc_dtype(x.dtype))
-    ev, vec = jnp.linalg.eigh(g)                       # ascending
-    ev = jnp.maximum(ev[::-1], 0.0)
-    vec = vec[:, ::-1]
-    if k is not None:
-        ev, vec = ev[:k], vec[:, :k]
-    return vec.astype(x.dtype), jnp.sqrt(ev).astype(_real_dtype(x.dtype))
+# svdvals / tallskinny_pca / jacobi_eigh live in bolt_tpu.ops.linalg

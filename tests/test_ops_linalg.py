@@ -1,0 +1,123 @@
+"""Batched Jacobi eigensolver tests (CPU mesh).
+
+``jacobi_eigh`` is the TPU-first engine behind the Gram-route
+``svdvals``/``tallskinny_pca`` (BASELINE config 5); the oracle is
+``numpy.linalg.eigvalsh`` in float64."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bolt_tpu.ops import jacobi_eigh
+
+
+def _gram(rs, b, n):
+    x = rs.randn(b, 4 * n, n)
+    return np.einsum("bni,bnj->bij", x, x)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 16, 17, 33, 64])
+def test_matches_numpy_across_sizes(n):
+    rs = np.random.RandomState(n)
+    g = _gram(rs, 6, n)
+    ref = np.linalg.eigvalsh(g)
+    got = np.asarray(jacobi_eigh(jnp.asarray(g)))
+    scale = np.abs(ref).max(axis=-1, keepdims=True)
+    assert np.max(np.abs(got - ref) / scale) < 5e-11
+
+
+def test_float32_precision_and_dtype():
+    rs = np.random.RandomState(0)
+    g = _gram(rs, 8, 16).astype(np.float32)
+    got = jacobi_eigh(jnp.asarray(g))
+    assert got.dtype == jnp.float32
+    ref = np.linalg.eigvalsh(g.astype(np.float64))
+    assert np.max(np.abs(np.asarray(got) - ref)
+                  / np.abs(ref).max(axis=-1, keepdims=True)) < 1e-5
+
+
+def test_indefinite_and_degenerate_spectra():
+    rs = np.random.RandomState(1)
+    # indefinite: symmetric but not PSD
+    a = rs.randn(4, 12, 12)
+    a = (a + np.swapaxes(a, -1, -2)) / 2
+    ref = np.linalg.eigvalsh(a)
+    got = np.asarray(jacobi_eigh(jnp.asarray(a)))
+    assert np.allclose(got, ref, atol=1e-10)
+    # repeated eigenvalues: identity and zero matrices are fixed points
+    assert np.allclose(np.asarray(jacobi_eigh(jnp.eye(7))), np.ones(7))
+    assert np.allclose(np.asarray(jacobi_eigh(jnp.zeros((3, 9, 9)))), 0.0)
+    # diagonal input returns the sorted diagonal
+    d = np.diag([3.0, -1.0, 2.0, 0.0, 5.0])
+    assert np.allclose(np.asarray(jacobi_eigh(jnp.asarray(d))),
+                       np.sort(np.diag(d)))
+
+
+def test_eigenvectors():
+    rs = np.random.RandomState(2)
+    for n in (2, 3, 8, 17):
+        a = rs.randn(5, n, n)
+        a = (a + np.swapaxes(a, -1, -2)) / 2
+        w, v = jacobi_eigh(jnp.asarray(a), vectors=True)
+        w, v = np.asarray(w), np.asarray(v)
+        # columns are orthonormal and diagonalize a: a @ v = v * w
+        eye = np.broadcast_to(np.eye(n), (5, n, n))
+        assert np.allclose(np.swapaxes(v, -1, -2) @ v, eye, atol=1e-10)
+        assert np.allclose(a @ v, v * w[..., None, :], atol=1e-9)
+        assert np.allclose(w, np.linalg.eigvalsh(a), atol=1e-10)
+
+
+@pytest.mark.parametrize("n", [5, 6])  # odd n: the padded-dummy path
+def test_extreme_scales_no_overflow(n):
+    # the atan2 rotation must survive scales where tau = (aqq-app)/(2*apq)
+    # would overflow f32 (the classic formula NaNs near convergence), and
+    # the odd-n dummy sentinel must not square the entries (f32 1e30-scale
+    # inputs would overflow to an inf sentinel and NaN the whole batch)
+    rs = np.random.RandomState(3)
+    base = _gram(rs, 2, n)
+    for scale in (1e-30, 1e30):
+        got = np.asarray(jacobi_eigh(jnp.asarray(base * scale)))
+        assert np.all(np.isfinite(got))
+        ref = np.linalg.eigvalsh(base * scale)
+        assert np.allclose(got, ref, rtol=1e-9)
+    got32 = np.asarray(jacobi_eigh(jnp.asarray(
+        (base[0] * 1e30).astype(np.float32))))
+    assert np.all(np.isfinite(got32))
+    ref = np.linalg.eigvalsh(base[0] * 1e30)
+    assert np.allclose(got32, ref, rtol=1e-4)
+
+
+def test_integer_input_promotes():
+    a = jnp.asarray([[2, 1], [1, 2]], jnp.int32)
+    got = np.asarray(jacobi_eigh(a))
+    assert np.allclose(got, [1.0, 3.0])
+
+
+def test_complex_falls_back():
+    rs = np.random.RandomState(4)
+    x = rs.randn(6, 4) + 1j * rs.randn(6, 4)
+    h = x.conj().T @ x
+    got = np.asarray(jacobi_eigh(jnp.asarray(h)))
+    assert np.allclose(got, np.linalg.eigvalsh(h), rtol=1e-9)
+    w, v = jacobi_eigh(jnp.asarray(h), vectors=True)
+    assert np.allclose(np.asarray(v) @ np.diag(np.asarray(w))
+                       @ np.asarray(v).conj().T, h, atol=1e-9)
+
+
+def test_bad_shape_rejected():
+    with pytest.raises(ValueError):
+        jacobi_eigh(jnp.zeros((3, 4)))
+    with pytest.raises(ValueError):
+        jacobi_eigh(jnp.zeros((5,)))
+
+
+def test_jit_and_vmap_compose():
+    import jax
+    rs = np.random.RandomState(5)
+    g = jnp.asarray(_gram(rs, 4, 8))
+    ref = np.linalg.eigvalsh(np.asarray(g))
+    got = np.asarray(jax.jit(jacobi_eigh)(g))
+    assert np.allclose(got, ref, atol=1e-10)
+    got_v = np.asarray(jax.vmap(jacobi_eigh)(g))
+    assert np.allclose(got_v, ref, atol=1e-10)
