@@ -1,0 +1,153 @@
+"""Distributed PCA tests (8-device CPU mesh).
+
+``ops.pca`` runs the reference ecosystem's PCA workload (per-chunk SVD
+through Spark — BASELINE config 5) as ONE compiled SPMD program over the
+sharded array; the oracle is float64 NumPy SVD."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.ops import pca
+
+
+def _ref_pca(x2d, k, center=False):
+    x = x2d.astype(np.float64)
+    if center:
+        x = x - x.mean(axis=0, keepdims=True)
+    u, s, vt = np.linalg.svd(x, full_matrices=False)
+    return (u[:, :k] * s[:k], vt[:k].T, s[:k])
+
+
+def _assert_matches(scores, comps, svals, ref, atol=1e-4):
+    rs_scores, rs_comps, rs_svals = ref
+    assert np.allclose(svals, rs_svals, rtol=1e-5, atol=atol)
+    got_scores = np.asarray(scores.toarray() if hasattr(scores, "toarray")
+                            else scores).reshape(rs_scores.shape)
+    for i in range(comps.shape[1]):
+        # eigenvector sign is arbitrary but scores and components must flip
+        # together: pick the sign from the component, then scores must match
+        sign = np.sign(np.dot(comps[:, i], rs_comps[:, i])) or 1.0
+        assert np.allclose(sign * comps[:, i], rs_comps[:, i], atol=1e-5)
+        assert np.allclose(sign * got_scores[:, i], rs_scores[:, i],
+                           atol=atol)
+
+
+def test_pca_matches_numpy_oracle(mesh):
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 12)
+    b = bolt.array(x, mesh, axis=(0,))
+    scores, comps, svals = pca(b, k=4)
+    assert scores.mode == "tpu" and scores.shape == (64, 4)
+    assert scores.split == 1
+    _assert_matches(scores, comps, svals, _ref_pca(x, 4))
+
+
+def test_pca_centering(mesh):
+    rs = np.random.RandomState(1)
+    x = rs.randn(48, 6) + 5.0          # big offset: centering must matter
+    b = bolt.array(x, mesh, axis=(0,))
+    scores, comps, svals = pca(b, k=3, center=True)
+    _assert_matches(scores, comps, svals, _ref_pca(x, 3, center=True))
+    # uncentered disagrees (offset dominates the first component)
+    _, _, sv_raw = pca(b, k=1)
+    assert not np.allclose(sv_raw, svals[:1], rtol=1e-2)
+
+
+def test_pca_multi_key_axes_keep_shape(mesh2d):
+    rs = np.random.RandomState(2)
+    x = rs.randn(8, 6, 5)
+    b = bolt.array(x, mesh2d, axis=(0, 1))   # 48 samples over a 2-d mesh
+    scores, comps, svals = pca(b, k=2)
+    assert scores.shape == (8, 6, 2) and scores.split == 2
+    _assert_matches(scores, comps, svals, _ref_pca(x.reshape(48, 5), 2))
+
+
+def test_pca_local_oracle_mode():
+    rs = np.random.RandomState(3)
+    x = rs.randn(32, 7)
+    b = bolt.array(x)                  # mode='local'
+    scores, comps, svals = pca(b, k=3)
+    assert scores.mode == "local" and scores.shape == (32, 3)
+    _assert_matches(scores, comps, svals, _ref_pca(x, 3))
+
+
+def test_pca_value_axes_flatten(mesh):
+    # value shape (4, 3) flattens to 12 features, scores keyed as input
+    rs = np.random.RandomState(4)
+    x = rs.randn(40, 4, 3)
+    b = bolt.array(x, mesh, axis=(0,))
+    scores, comps, svals = pca(b, k=5)
+    assert scores.shape == (40, 5)
+    _assert_matches(scores, comps, svals, _ref_pca(x.reshape(40, 12), 5))
+
+
+def test_pca_default_k_and_errors(mesh):
+    rs = np.random.RandomState(5)
+    b = bolt.array(rs.randn(16, 4), mesh, axis=(0,))
+    scores, comps, svals = pca(b)
+    assert comps.shape == (4, 4) and svals.shape == (4,)
+    with pytest.raises(ValueError):
+        pca(bolt.array(rs.randn(3, 8), mesh, axis=(0,)))   # n < d
+    with pytest.raises(ValueError):
+        pca(b, k=9)
+    with pytest.raises(TypeError):
+        pca(rs.randn(16, 4))                               # not a bolt array
+
+
+def test_pca_local_complex_conjugates():
+    # the local Gram must use the conjugate transpose: a plain x.T @ x is
+    # non-Hermitian and np.linalg.eigh silently returns garbage from it
+    rs = np.random.RandomState(7)
+    x = rs.randn(64, 5) + 1j * rs.randn(64, 5)
+    _, _, svals = pca(bolt.array(x), k=5)
+    expect = np.linalg.svd(x, compute_uv=False)
+    assert np.allclose(svals, expect, rtol=1e-8)
+
+
+def test_pca_integer_input_widens(mesh):
+    # int input must promote to float on BOTH backends (int components
+    # would truncate to all zeros)
+    rs = np.random.RandomState(8)
+    counts = rs.poisson(20.0, size=(40, 6)).astype(np.int64)
+    ref = _ref_pca(counts.astype(np.float64), 2)
+    for b in (bolt.array(counts), bolt.array(counts, mesh, axis=(0,))):
+        scores, comps, svals = pca(b, k=2)
+        assert np.issubdtype(comps.dtype, np.floating)
+        assert np.abs(comps).max() > 0.1
+        _assert_matches(scores, comps, svals, ref)
+
+
+def test_pca_axis_parameter_matches_across_backends(mesh):
+    # axis names the sample axes (map's convention); a non-leading axis
+    # aligns by swap on TPU and by moveaxis locally — same result
+    rs = np.random.RandomState(9)
+    x = rs.randn(6, 48, 5)
+    ref = _ref_pca(np.moveaxis(x, 1, 0).reshape(48, 6 * 5), 3)
+    bt = bolt.array(x, mesh, axis=(0,))
+    st, ct, vt_ = pca(bt, k=3, axis=(1,))
+    assert st.shape == (48, 3)
+    _assert_matches(st, ct, vt_, ref)
+    sl, cl, vl = pca(bolt.array(x), k=3, axis=(1,))
+    assert sl.shape == (48, 3)
+    _assert_matches(sl, cl, vl, ref)
+
+
+def test_pca_program_cache_hits(mesh):
+    # same shape/dtype/mesh/k must reuse the compiled program
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    rs = np.random.RandomState(10)
+    b = bolt.array(rs.randn(32, 4), mesh, axis=(0,))
+    pca(b, k=2)
+    n_after_first = len(_JIT_CACHE)
+    pca(b, k=2)
+    assert len(_JIT_CACHE) == n_after_first
+
+
+def test_pca_composes_with_map_chain(mesh):
+    # a deferred map chain must materialise before the decomposition
+    rs = np.random.RandomState(6)
+    x = rs.randn(32, 6)
+    b = bolt.array(x, mesh, axis=(0,)).map(lambda v: v * 2.0)
+    scores, comps, svals = pca(b, k=2)
+    _assert_matches(scores, comps, svals, _ref_pca(x * 2.0, 2))
