@@ -199,6 +199,7 @@ def svdvals(x, gram_ratio=4):
     PCA-style spectra, not for rank-revealing use.  Wide or near-square
     inputs fall back to ``jnp.linalg.svd``.
     """
+    x = _widen(jnp.asarray(x), jnp)
     rows, cols = x.shape[-2], x.shape[-1]
     if rows >= gram_ratio * cols:
         g = jnp.matmul(_adjoint(x), x, precision="highest",
@@ -312,13 +313,16 @@ def pca(b, k=None, center=False, axis=None):
         return (type(b)(scores), vec, np.sqrt(ev).astype(_real_dtype(x.dtype)))
 
     from bolt_tpu.parallel.sharding import key_sharding
-    from bolt_tpu.tpu.array import _cached_jit
-    data = b.tojax()
+    from bolt_tpu.tpu.array import _cached_jit, _chain_apply
+    # a deferred map chain fuses INTO the PCA program (one XLA program,
+    # no materialised intermediate), same as map/filter/reduce consumers
+    base, funcs = b._chain_parts()
     mesh = b._mesh
 
     def build():
         def program(data):
-            x = _widen(data.reshape((n, d)), jnp)
+            mapped = _chain_apply(funcs, split, data)
+            x = _widen(mapped.reshape((n, d)), jnp)
             if center:
                 x = x - jnp.mean(x, axis=0, keepdims=True)
             vec, ev = _gram_decompose(x, k, jnp, _tpu_eigh)
@@ -331,9 +335,9 @@ def pca(b, k=None, center=False, axis=None):
             return scores, vec, jnp.sqrt(ev)
         return jax.jit(program)
 
-    fn = _cached_jit(("ops-pca", shape, str(b.dtype), split, mesh, k, center),
-                     build)
-    scores, vec, sv = fn(data)
+    fn = _cached_jit(("ops-pca", funcs, base.shape, str(base.dtype), split,
+                      mesh, k, center), build)
+    scores, vec, sv = fn(base)
     out = type(b)(scores, split, mesh)
     return (out, np.asarray(jax.device_get(vec)),
             np.asarray(jax.device_get(sv)))
@@ -352,5 +356,6 @@ def tallskinny_pca(x, k=None):
             "tallskinny_pca requires n >= d (got %d x %d): the rank-%d Gram "
             "matrix would pad the spectrum with zero eigenvalues whose "
             "eigenvectors are arbitrary; use jnp.linalg.svd" % (n, d, n))
+    x = _widen(jnp.asarray(x), jnp)
     vec, ev = _gram_decompose(x, d if k is None else k, jnp, _tpu_eigh)
     return vec.astype(x.dtype), jnp.sqrt(ev).astype(_real_dtype(x.dtype))

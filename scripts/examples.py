@@ -96,6 +96,16 @@ def main():
     assert np.allclose(np.asarray(sv.toarray())[0], expect, rtol=1e-2, atol=1e-2)
 
     # ------------------------------------------------------------------
+    section("5b. whole-array distributed PCA (one SPMD program)")
+    from bolt_tpu.ops import pca
+    scores, comps, svals = pca(bolt.array(data, mesh, axis=(0,)),
+                               k=4, center=True)
+    xc = data - data.mean(axis=0)
+    expect_sv = np.linalg.svd(xc, compute_uv=False)[:4]
+    assert np.allclose(svals, expect_sv, rtol=1e-3)
+    assert scores.mode == "tpu" and scores.shape == (npts, 4)
+
+    # ------------------------------------------------------------------
     section("6. select + mask: keyed filtering")
     means = stack.mean(axis=(1, 2))
     bright = b.filter(lambda im: im.mean() > 0)

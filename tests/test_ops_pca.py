@@ -145,9 +145,28 @@ def test_pca_program_cache_hits(mesh):
 
 
 def test_pca_composes_with_map_chain(mesh):
-    # a deferred map chain must materialise before the decomposition
+    # a deferred map chain fuses INTO the PCA program — correct result,
+    # and the source array stays deferred (no forced materialisation)
     rs = np.random.RandomState(6)
     x = rs.randn(32, 6)
     b = bolt.array(x, mesh, axis=(0,)).map(lambda v: v * 2.0)
+    assert b.deferred
     scores, comps, svals = pca(b, k=2)
+    assert b.deferred
     _assert_matches(scores, comps, svals, _ref_pca(x * 2.0, 2))
+
+
+def test_tallskinny_and_svdvals_integer_widen():
+    # int input must come back as float principal components / singular
+    # values (int would truncate components to all zeros)
+    from bolt_tpu.ops import svdvals, tallskinny_pca
+    rs = np.random.RandomState(11)
+    counts = rs.poisson(20.0, size=(40, 6)).astype(np.int32)
+    comps, svals = tallskinny_pca(counts, k=2)
+    assert np.issubdtype(np.asarray(comps).dtype, np.floating)
+    assert np.abs(np.asarray(comps)).max() > 0.1
+    expect = np.linalg.svd(counts.astype(np.float64), compute_uv=False)
+    assert np.allclose(np.asarray(svals), expect[:2], rtol=1e-6)
+    sv = np.asarray(svdvals(counts))
+    assert np.issubdtype(sv.dtype, np.floating)
+    assert np.allclose(sv, expect, rtol=1e-6)

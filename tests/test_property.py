@@ -168,3 +168,21 @@ def test_filter_parity_random_threshold(mesh, case, thresh):
     expected = flat[flat.mean(axis=tuple(range(1, flat.ndim))) > thresh]
     assert got.shape == expected.shape
     assert allclose(got.toarray(), expected)
+
+
+@given(st.integers(1, 20), st.integers(1, 4), st.integers(0, 2 ** 16),
+       st.sampled_from([1e-8, 1.0, 1e8]))
+@settings(**SETTINGS)
+def test_jacobi_eigh_matches_numpy(n, batch, seed, scale):
+    # random symmetric batches across sizes (odd and even), scales, and
+    # batch dims: eigenvalues must match LAPACK, vectors must diagonalize
+    from bolt_tpu.ops import jacobi_eigh
+    rs = np.random.RandomState(seed)
+    a = rs.randn(batch, n, n) * scale
+    a = (a + np.swapaxes(a, -1, -2)) / 2
+    w, v = jacobi_eigh(a, vectors=True)
+    w, v = np.asarray(w), np.asarray(v)
+    ref = np.linalg.eigvalsh(a)
+    anorm = np.abs(ref).max() + 1e-300
+    assert np.max(np.abs(w - ref)) / anorm < 1e-10
+    assert np.max(np.abs(a @ v - v * w[..., None, :])) / anorm < 1e-9
