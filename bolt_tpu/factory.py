@@ -61,6 +61,27 @@ def zeros(shape, context=None, axis=(0,), mode=None, dtype=None):
     return ConstructTPU.zeros(shape, context=context, axis=axis, dtype=dtype)
 
 
+def randn(shape, context=None, axis=(0,), mode=None, dtype=None, seed=0):
+    """Bolt array of standard normals (extension beyond the reference
+    factory).  ``mode='tpu'`` generates each shard on its own device — no
+    host materialisation; backends use different RNG streams."""
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.randn(shape, dtype=dtype, seed=seed)
+    return ConstructTPU.randn(shape, context=context, axis=axis, dtype=dtype,
+                              seed=seed)
+
+
+def rand(shape, context=None, axis=(0,), mode=None, dtype=None, seed=0):
+    """Bolt array of uniform [0, 1) samples (extension beyond the reference
+    factory); see :func:`randn`."""
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.rand(shape, dtype=dtype, seed=seed)
+    return ConstructTPU.rand(shape, context=context, axis=axis, dtype=dtype,
+                             seed=seed)
+
+
 def concatenate(arrays, axis=0, context=None, mode=None):
     """Concatenate bolt arrays (reference: ``bolt/factory.py ::
     concatenate``).  Dispatches on the first array's backend unless

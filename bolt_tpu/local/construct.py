@@ -32,6 +32,31 @@ class ConstructLocal:
         return BoltArrayLocal(np.zeros(shape, dtype=dtype))
 
     @staticmethod
+    def _float_dtype(dtype):
+        if dtype is not None and not np.issubdtype(np.dtype(dtype),
+                                                   np.floating):
+            # same contract as the TPU backend: truncating uniform [0, 1)
+            # to int would silently return zeros
+            raise ValueError("random constructors require a float dtype, "
+                             "got %s" % np.dtype(dtype))
+        return dtype
+
+    @staticmethod
+    def randn(shape, dtype=None, seed=0):
+        """Standard-normal array (extension beyond the reference factory;
+        RNG streams differ between backends by construction)."""
+        dtype = ConstructLocal._float_dtype(dtype)
+        x = np.random.default_rng(seed).standard_normal(shape)
+        return BoltArrayLocal(x.astype(dtype) if dtype is not None else x)
+
+    @staticmethod
+    def rand(shape, dtype=None, seed=0):
+        """Uniform [0, 1) array (extension beyond the reference factory)."""
+        dtype = ConstructLocal._float_dtype(dtype)
+        x = np.random.default_rng(seed).random(shape)
+        return BoltArrayLocal(x.astype(dtype) if dtype is not None else x)
+
+    @staticmethod
     def concatenate(arrays, axis=0):
         if not isinstance(arrays, (tuple, list)) or len(arrays) == 0:
             raise ValueError("concatenate requires a non-empty tuple of arrays")

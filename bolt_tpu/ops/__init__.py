@@ -1,5 +1,6 @@
 from bolt_tpu.ops.kernels import fused_map_reduce, fused_stats
-from bolt_tpu.ops.linalg import (jacobi_eigh, pca, svdvals, tallskinny_pca)
+from bolt_tpu.ops.linalg import (jacobi_eigh, pca, svdvals, tallskinny_pca,
+                                 tsqr)
 
 __all__ = ["fused_map_reduce", "fused_stats", "jacobi_eigh", "pca",
-           "svdvals", "tallskinny_pca"]
+           "svdvals", "tallskinny_pca", "tsqr"]

@@ -107,8 +107,11 @@ class ConstructTPU:
         return BoltArrayTPU(data, split, mesh)
 
     @staticmethod
-    def _filled(fill, shape, context, axis, dtype):
-        from bolt_tpu.tpu.array import BoltArrayTPU
+    def _device_build_spec(shape, context, axis, dtype):
+        """Shared prologue for the build-directly-on-device constructors:
+        ``(mesh, key-axes-first shape, split, canonical dtype, sharding)``
+        — the key-axis permutation and dtype rules must stay identical
+        across ``ones``/``zeros``/``rand``/``randn``."""
         mesh = ConstructTPU._resolve(context)
         shape = tupleize(shape)
         axes = sorted(tupleize(axis))
@@ -121,9 +124,48 @@ class ConstructTPU:
             dtype = np.float64  # numpy's default, canonicalised below
         dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
         sharding = key_sharding(mesh, shape, len(axes))
+        return mesh, shape, len(axes), dtype, sharding
+
+    @staticmethod
+    def _filled(fill, shape, context, axis, dtype):
+        from bolt_tpu.tpu.array import BoltArrayTPU
+        mesh, shape, split, dtype, sharding = \
+            ConstructTPU._device_build_spec(shape, context, axis, dtype)
         build = jax.jit(lambda: jnp.full(shape, fill, dtype=dtype),
                         out_shardings=sharding)
-        return BoltArrayTPU(build(), len(axes), mesh)
+        return BoltArrayTPU(build(), split, mesh)
+
+    @staticmethod
+    def _random(kind, shape, context, axis, dtype, seed):
+        """Sharded random array, generated ON the devices: one jitted
+        program with sharded output, so each device computes only its own
+        shard's stream (threefry is counter-based/partitionable) and a
+        10 GB random array never exists on the host — the same
+        no-host-materialisation rule as ``ones``/``zeros``.  Extension
+        beyond the reference factory (which has only
+        array/ones/zeros/concatenate); RNG streams differ from the local
+        backend's NumPy generator by construction."""
+        from bolt_tpu.tpu.array import BoltArrayTPU
+        mesh, shape, split, dtype, sharding = \
+            ConstructTPU._device_build_spec(shape, context, axis, dtype)
+        if not jnp.issubdtype(dtype, jnp.floating):
+            raise ValueError("random constructors require a float dtype, "
+                             "got %s" % dtype)
+        sampler = jax.random.normal if kind == "randn" else jax.random.uniform
+        build = jax.jit(
+            lambda: sampler(jax.random.key(seed), shape, dtype=dtype),
+            out_shardings=sharding)
+        return BoltArrayTPU(build(), split, mesh)
+
+    @staticmethod
+    def randn(shape, context=None, axis=(0,), dtype=None, seed=0):
+        """Sharded standard-normal array, generated directly on device."""
+        return ConstructTPU._random("randn", shape, context, axis, dtype, seed)
+
+    @staticmethod
+    def rand(shape, context=None, axis=(0,), dtype=None, seed=0):
+        """Sharded uniform [0, 1) array, generated directly on device."""
+        return ConstructTPU._random("rand", shape, context, axis, dtype, seed)
 
     @staticmethod
     def ones(shape, context=None, axis=(0,), dtype=None):

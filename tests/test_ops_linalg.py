@@ -121,3 +121,35 @@ def test_jit_and_vmap_compose():
     assert np.allclose(got, ref, atol=1e-10)
     got_v = np.asarray(jax.vmap(jacobi_eigh)(g))
     assert np.allclose(got_v, ref, atol=1e-10)
+
+
+def test_tsqr_matches_qr():
+    import jax.numpy as jnp
+    from bolt_tpu.ops import tsqr
+    rs = np.random.RandomState(6)
+    for shape in [(64, 8), (3, 100, 12), (40, 1)]:
+        x = rs.randn(*shape)
+        q, r = tsqr(jnp.asarray(x))
+        q, r = np.asarray(q), np.asarray(r)
+        d = shape[-1]
+        eye = np.broadcast_to(np.eye(d), r.shape)
+        assert np.allclose(np.swapaxes(q, -1, -2) @ q, eye, atol=1e-12)
+        assert np.allclose(q @ r, x, atol=1e-12)
+        # upper triangular with positive diagonal (unlike np.linalg.qr,
+        # whose sign convention is unspecified)
+        assert np.allclose(np.tril(r, -1), 0.0, atol=1e-12)
+        assert np.all(np.diagonal(r, axis1=-2, axis2=-1) > 0)
+
+
+def test_tsqr_f32_and_int_and_errors():
+    import jax.numpy as jnp
+    from bolt_tpu.ops import tsqr
+    rs = np.random.RandomState(7)
+    x = rs.randn(256, 6).astype(np.float32)
+    q, r = tsqr(jnp.asarray(x))
+    assert np.asarray(q).dtype == np.float32
+    assert np.allclose(np.asarray(q) @ np.asarray(r), x, atol=1e-4)
+    qi, ri = tsqr(jnp.asarray((x * 10).astype(np.int32)))
+    assert np.issubdtype(np.asarray(qi).dtype, np.floating)
+    with pytest.raises(ValueError):
+        tsqr(jnp.zeros((4, 8)))

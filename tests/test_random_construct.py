@@ -1,0 +1,71 @@
+"""Random sharded constructors (extension beyond the reference factory:
+``rand``/``randn`` generate each shard on its own device — the same
+no-host-materialisation rule as ``ones``/``zeros``)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import bolt_tpu as bolt
+
+
+def test_randn_sharded_and_deterministic(mesh):
+    b = bolt.randn((16, 4, 3), mesh, axis=(0,), dtype=np.float32, seed=7)
+    assert b.mode == "tpu" and b.split == 1
+    assert b.shape == (16, 4, 3) and b.dtype == np.float32
+    # sharded over the mesh, not replicated
+    assert not b.tojax().sharding.is_fully_replicated
+    # same seed reproduces, different seed differs
+    again = bolt.randn((16, 4, 3), mesh, axis=(0,), dtype=np.float32, seed=7)
+    other = bolt.randn((16, 4, 3), mesh, axis=(0,), dtype=np.float32, seed=8)
+    assert np.array_equal(b.toarray(), again.toarray())
+    assert not np.array_equal(b.toarray(), other.toarray())
+
+
+def test_randn_moments(mesh):
+    b = bolt.randn((64, 32, 16), mesh, dtype=np.float32, seed=0)
+    x = b.toarray()
+    assert abs(x.mean()) < 0.02 and abs(x.std() - 1.0) < 0.02
+
+
+def test_rand_range_and_mode_dispatch(mesh):
+    b = bolt.rand((32, 8), mesh, dtype=np.float32)
+    x = b.toarray()
+    assert x.min() >= 0.0 and x.max() < 1.0
+    # local dispatch without a mesh
+    lo = bolt.rand((32, 8))
+    assert lo.mode == "local" and lo.shape == (32, 8)
+    lo2 = bolt.randn((32, 8), seed=3)
+    assert lo2.mode == "local"
+    assert np.array_equal(np.asarray(lo2),
+                          np.asarray(bolt.randn((32, 8), seed=3)))
+
+
+def test_random_local_rejects_non_float():
+    # local must match the TPU contract, not silently truncate to zeros
+    with pytest.raises(ValueError):
+        bolt.rand((8, 4), dtype=np.int32)
+    with pytest.raises(ValueError):
+        bolt.randn((8, 4), dtype=np.int64)
+
+
+def test_random_pipeline_end_to_end(mesh):
+    # generated arrays are ordinary bolt arrays: map/stats/swap all work
+    b = bolt.randn((8, 6, 4), mesh, axis=(0, 1), dtype=np.float32, seed=1)
+    assert b.split == 2
+    m = b.map(lambda v: v * 2.0, axis=(0, 1))
+    assert np.allclose(m.toarray(), b.toarray() * 2.0)
+    assert np.allclose(np.asarray(b.stats().mean()),
+                       b.toarray().mean(axis=(0, 1)), atol=1e-6)
+
+
+def test_random_rejects_non_float(mesh):
+    with pytest.raises(ValueError):
+        bolt.randn((8, 4), mesh, dtype=np.int32)
+
+
+def test_random_key_axis_moves_front(mesh):
+    # axis=(1,) distributes that axis; it moves to the front like array()
+    b = bolt.randn((6, 16, 3), mesh, axis=(1,), dtype=np.float32)
+    assert b.shape == (16, 6, 3) and b.split == 1
