@@ -82,6 +82,20 @@ def rand(shape, context=None, axis=(0,), mode=None, dtype=None, seed=0):
                              seed=seed)
 
 
+def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None):
+    """Build a bolt array by calling ``fn(index_slices) -> block`` per
+    shard — the sharded data-loader (extension beyond the reference
+    factory, whose ``sc.parallelize`` scatter needs the full array at the
+    driver).  ``mode='tpu'``: one call per device shard, each process
+    loading only its own devices' blocks; local mode: one call for the
+    whole array."""
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.fromcallback(fn, shape, axis=axis, dtype=dtype)
+    return ConstructTPU.fromcallback(fn, shape, context=context, axis=axis,
+                                     dtype=dtype)
+
+
 def concatenate(arrays, axis=0, context=None, mode=None):
     """Concatenate bolt arrays (reference: ``bolt/factory.py ::
     concatenate``).  Dispatches on the first array's backend unless

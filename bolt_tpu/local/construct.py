@@ -42,6 +42,27 @@ class ConstructLocal:
         return dtype
 
     @staticmethod
+    def fromcallback(fn, shape, axis=(0,), dtype=None):
+        """Local analog of the sharded loader: one callback call covering
+        the whole array (a single 'shard').  ``axis`` gets the same
+        key-axes-first treatment as the TPU backend, so a loader written
+        against one backend serves the other unchanged."""
+        shape = tuple(shape)
+        axes = sorted(axis if isinstance(axis, (tuple, list)) else (axis,))
+        rest = [i for i in range(len(shape)) if i not in axes]
+        if len(axes) + len(rest) != len(shape) or any(
+                a < 0 or a >= len(shape) for a in axes):
+            raise ValueError("axis %s out of range for shape %s"
+                             % (axes, shape))
+        shape = tuple(shape[i] for i in axes + rest)
+        block = np.asarray(fn(tuple(slice(0, n) for n in shape)),
+                           dtype=dtype)
+        if block.shape != shape:
+            raise ValueError("fromcallback callback returned shape %s "
+                             "(expected %s)" % (block.shape, shape))
+        return BoltArrayLocal(block)
+
+    @staticmethod
     def randn(shape, dtype=None, seed=0):
         """Standard-normal array (extension beyond the reference factory;
         RNG streams differ between backends by construction)."""

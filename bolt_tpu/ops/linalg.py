@@ -261,14 +261,26 @@ def tsqr(x):
         raise ValueError("tsqr requires (..., n, d) with n >= d, got %s"
                          % (x.shape,))
 
+    d = x.shape[-1]
+    eye = jnp.eye(d, dtype=x.dtype)
+
     def _chol_qr(a):
         g = jnp.matmul(_adjoint(a), a, precision="highest",
                        preferred_element_type=_acc_dtype(a.dtype))
         l = jnp.linalg.cholesky(g)                       # g = l @ l^H
-        # q = a @ r^-1 = (l^-1 @ a^H)^H, one triangular solve
-        q = _adjoint(jax.scipy.linalg.solve_triangular(
-            l, _adjoint(a), lower=True))
-        return q, _adjoint(l)
+        r = _adjoint(l)
+        # invert only the small (d, d) triangle, then apply by matmul so
+        # the O(n d^2) work runs at controlled precision (TPU's
+        # TriangularSolve applies blocked matmuls at the bf16 default,
+        # which would cap orthogonality ~1e-3 on f32 data).  One Newton
+        # step X <- X(2I - RX) at precision="highest" scrubs the solve's
+        # own rounding back to f32 eps.
+        r_inv = _adjoint(jax.scipy.linalg.solve_triangular(
+            l, jnp.broadcast_to(eye, l.shape), lower=True))
+        correction = 2.0 * eye - jnp.matmul(r, r_inv, precision="highest")
+        r_inv = jnp.matmul(r_inv, correction, precision="highest")
+        q = jnp.matmul(a, r_inv, precision="highest")
+        return q, r
 
     q1, r1 = _chol_qr(x)
     q, r2 = _chol_qr(q1)                                 # re-orthogonalise

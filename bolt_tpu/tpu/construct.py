@@ -145,17 +145,66 @@ class ConstructTPU:
         beyond the reference factory (which has only
         array/ones/zeros/concatenate); RNG streams differ from the local
         backend's NumPy generator by construction."""
-        from bolt_tpu.tpu.array import BoltArrayTPU
+        from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit
         mesh, shape, split, dtype, sharding = \
             ConstructTPU._device_build_spec(shape, context, axis, dtype)
         if not jnp.issubdtype(dtype, jnp.floating):
             raise ValueError("random constructors require a float dtype, "
                              "got %s" % dtype)
         sampler = jax.random.normal if kind == "randn" else jax.random.uniform
-        build = jax.jit(
-            lambda: sampler(jax.random.key(seed), shape, dtype=dtype),
-            out_shardings=sharding)
-        return BoltArrayTPU(build(), split, mesh)
+
+        def builder():
+            # seed is a traced argument: one compile per (kind, shape,
+            # dtype, mesh), reused across seeds
+            return jax.jit(
+                lambda seed: sampler(jax.random.key(seed), shape,
+                                     dtype=dtype),
+                out_shardings=sharding)
+
+        fn = _cached_jit(("construct-random", kind, shape, str(dtype), mesh),
+                         builder)
+        return BoltArrayTPU(fn(jnp.uint32(seed)), split, mesh)
+
+    @staticmethod
+    def fromcallback(fn, shape, context=None, axis=(0,), dtype=None):
+        """Build a distributed array by calling ``fn`` once per device
+        shard — the sharded data-loader slot.
+
+        ``fn(index)`` receives a tuple of per-axis ``slice`` objects
+        covering one shard of the KEY-AXES-FIRST logical ``shape`` and
+        returns that block (anything ``np.asarray`` accepts: a memmap
+        read, an HDF5/zarr slice, a computed tile).  Each process loads
+        only its own devices' shards, so an array larger than any single
+        host's RAM streams straight from storage onto the mesh.  The
+        reference's analog is the driver-side ``sc.parallelize`` scatter
+        (``bolt/spark/construct.py :: ConstructSpark.array``), which
+        must materialise the full array at the driver first; here no
+        full copy ever exists anywhere.
+
+        Note ``shape`` is interpreted key-axes-first (like
+        ``ones``/``zeros``): ``axis`` names which of those axes are
+        keys, and they are moved to the front before ``fn`` sees slices.
+        """
+        from bolt_tpu.tpu.array import BoltArrayTPU
+        explicit = dtype is not None
+        mesh, shape, split, dtype, sharding = \
+            ConstructTPU._device_build_spec(shape, context, axis, dtype)
+        # dtype=None means "whatever the callback produces" (the loader
+        # knows its storage dtype); an explicit dtype converts each block
+        dtype = dtype if explicit else None
+
+        def produce(index):
+            block = np.asarray(fn(index), dtype=dtype)
+            want = tuple(len(range(*s.indices(n)))
+                         for s, n in zip(index, shape))
+            if block.shape != want:
+                raise ValueError(
+                    "fromcallback callback returned shape %s for index %s "
+                    "(expected %s)" % (block.shape, index, want))
+            return block
+
+        data = jax.make_array_from_callback(shape, sharding, produce)
+        return BoltArrayTPU(data, split, mesh)
 
     @staticmethod
     def randn(shape, context=None, axis=(0,), dtype=None, seed=0):

@@ -69,3 +69,13 @@ def test_random_key_axis_moves_front(mesh):
     # axis=(1,) distributes that axis; it moves to the front like array()
     b = bolt.randn((6, 16, 3), mesh, axis=(1,), dtype=np.float32)
     assert b.shape == (16, 6, 3) and b.split == 1
+
+
+def test_random_program_cache_reused_across_seeds(mesh):
+    # seed is a traced argument: new seeds must NOT grow the jit cache
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    bolt.randn((8, 4), mesh, dtype=np.float32, seed=0)
+    size = len(_JIT_CACHE)
+    for seed in (1, 2, 3):
+        bolt.randn((8, 4), mesh, dtype=np.float32, seed=seed)
+    assert len(_JIT_CACHE) == size
