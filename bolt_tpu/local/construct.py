@@ -47,13 +47,11 @@ class ConstructLocal:
         the whole array (a single 'shard').  ``axis`` gets the same
         key-axes-first treatment as the TPU backend, so a loader written
         against one backend serves the other unchanged."""
+        from bolt_tpu.utils import inshape, tupleize
         shape = tuple(shape)
-        axes = sorted(axis if isinstance(axis, (tuple, list)) else (axis,))
+        axes = sorted(tupleize(axis))
+        inshape(shape, axes)
         rest = [i for i in range(len(shape)) if i not in axes]
-        if len(axes) + len(rest) != len(shape) or any(
-                a < 0 or a >= len(shape) for a in axes):
-            raise ValueError("axis %s out of range for shape %s"
-                             % (axes, shape))
         shape = tuple(shape[i] for i in axes + rest)
         block = np.asarray(fn(tuple(slice(0, n) for n in shape)),
                            dtype=dtype)
@@ -67,14 +65,15 @@ class ConstructLocal:
         """Standard-normal array (extension beyond the reference factory;
         RNG streams differ between backends by construction)."""
         dtype = ConstructLocal._float_dtype(dtype)
-        x = np.random.default_rng(seed).standard_normal(shape)
+        # same seed normalization as the TPU backend: any Python int works
+        x = np.random.default_rng(seed % (1 << 32)).standard_normal(shape)
         return BoltArrayLocal(x.astype(dtype) if dtype is not None else x)
 
     @staticmethod
     def rand(shape, dtype=None, seed=0):
         """Uniform [0, 1) array (extension beyond the reference factory)."""
         dtype = ConstructLocal._float_dtype(dtype)
-        x = np.random.default_rng(seed).random(shape)
+        x = np.random.default_rng(seed % (1 << 32)).random(shape)
         return BoltArrayLocal(x.astype(dtype) if dtype is not None else x)
 
     @staticmethod

@@ -161,9 +161,10 @@ class ConstructTPU:
                                      dtype=dtype),
                 out_shardings=sharding)
 
-        fn = _cached_jit(("construct-random", kind, shape, str(dtype), mesh),
-                         builder)
-        return BoltArrayTPU(fn(jnp.uint32(seed)), split, mesh)
+        fn = _cached_jit(("construct-random", kind, shape, str(dtype), split,
+                          mesh), builder)
+        # normalize: any Python int works, matching the local backend
+        return BoltArrayTPU(fn(jnp.uint32(seed % (1 << 32))), split, mesh)
 
     @staticmethod
     def fromcallback(fn, shape, context=None, axis=(0,), dtype=None):

@@ -123,6 +123,20 @@ def main():
         assert b2.split == b.split
         assert np.allclose(b2.toarray(), stack)
 
+    # ------------------------------------------------------------------
+    section("8. sharded loading + on-device RNG")
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "big.npy")
+        disk = rs.randn(64, 32).astype(np.float32)
+        np.save(path, disk)
+        mm = np.load(path, mmap_mode="r")
+        # each device reads ONLY its own slice of the file
+        ld = bolt.fromcallback(lambda idx: mm[idx], mm.shape, mesh)
+        assert np.array_equal(ld.toarray(), disk)
+    rnd = bolt.randn((64, 32), mesh, dtype=np.float32, seed=0)
+    assert abs(float(np.asarray(rnd.toarray()).mean())) < 0.1
+
     print("ALL EXAMPLES OK")
 
 

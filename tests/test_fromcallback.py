@@ -89,3 +89,15 @@ def test_fromcallback_axis_consistent_across_backends(mesh):
                            axis=(1,))
     assert lo.shape == tp.shape == (8, 4, 2)
     assert np.array_equal(np.asarray(lo), tp.toarray())
+
+
+def test_fromcallback_local_axis_forms(mesh):
+    # range/ndarray axis values normalize like the TPU backend (tupleize)
+    full = _oracle((4, 6))
+    lo = bolt.fromcallback(lambda idx: full[idx], (4, 6), axis=range(1))
+    assert np.array_equal(np.asarray(lo), full)
+    lo2 = bolt.fromcallback(lambda idx: full[idx], (4, 6),
+                            axis=np.array([0]))
+    assert np.array_equal(np.asarray(lo2), full)
+    with pytest.raises(ValueError):
+        bolt.fromcallback(lambda idx: full[idx], (4, 6), axis=(5,))

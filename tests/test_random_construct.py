@@ -79,3 +79,27 @@ def test_random_program_cache_reused_across_seeds(mesh):
     for seed in (1, 2, 3):
         bolt.randn((8, 4), mesh, dtype=np.float32, seed=seed)
     assert len(_JIT_CACHE) == size
+
+
+def test_random_sharding_keyed_by_split(mesh2d):
+    # (kind, shape, dtype, mesh)-equal calls with different key-axis counts
+    # must NOT share a compiled program: shardings differ
+    a = bolt.randn((8, 4), mesh2d, axis=(0,), dtype=np.float32)
+    b = bolt.randn((8, 4), mesh2d, axis=(0, 1), dtype=np.float32)
+    assert a.split == 1 and b.split == 2
+    sa = a.tojax().sharding.spec
+    sb = b.tojax().sharding.spec
+    assert tuple(sa)[:1] != tuple(sb)[:2] or len(tuple(sa)) != len(tuple(sb)) \
+        or sa != sb
+    # the value axis of `a` must not be mesh-sharded
+    assert len([p for p in tuple(sa) if p is not None]) <= 1
+
+
+def test_random_negative_and_huge_seeds(mesh):
+    # any Python int seed works, matching the local backend
+    a = bolt.randn((8, 4), mesh, dtype=np.float32, seed=-1)
+    b = bolt.randn((8, 4), mesh, dtype=np.float32, seed=2 ** 40 + 5)
+    assert np.all(np.isfinite(a.toarray()))
+    assert not np.array_equal(a.toarray(), b.toarray())
+    lo = bolt.randn((8, 4), seed=-1)
+    assert lo.mode == "local"
