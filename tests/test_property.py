@@ -186,3 +186,33 @@ def test_jacobi_eigh_matches_numpy(n, batch, seed, scale):
     anorm = np.abs(ref).max() + 1e-300
     assert np.max(np.abs(w - ref)) / anorm < 1e-10
     assert np.max(np.abs(a @ v - v * w[..., None, :])) / anorm < 1e-9
+
+
+@given(st.integers(8, 40), st.integers(1, 6), st.integers(0, 2 ** 16),
+       st.booleans())
+@settings(**SETTINGS)
+def test_pca_matches_numpy(mesh, n_extra, d, seed, center):
+    # random sample/feature sizes: singular values must match float64 SVD
+    from bolt_tpu.ops import pca
+    n = d + n_extra
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d)
+    b = bolt.array(x, mesh, axis=(0,))
+    _, _, svals = pca(b, center=center)
+    ref = x - x.mean(axis=0, keepdims=True) if center else x
+    expect = np.linalg.svd(ref, compute_uv=False)
+    assert np.allclose(svals, expect, rtol=1e-8, atol=1e-10 * max(1.0, expect[0]))
+
+
+@given(st.integers(1, 12), st.integers(0, 2 ** 16))
+@settings(**SETTINGS)
+def test_tsqr_properties(mesh, d, seed):
+    from bolt_tpu.ops import tsqr
+    import jax.numpy as jnp
+    rs = np.random.RandomState(seed)
+    x = rs.randn(4 * d + 8, d)
+    q, r = tsqr(jnp.asarray(x))
+    q, r = np.asarray(q), np.asarray(r)
+    assert np.allclose(q.T @ q, np.eye(d), atol=1e-12)
+    assert np.allclose(q @ r, x, atol=1e-12)
+    assert np.allclose(np.tril(r, -1), 0.0, atol=1e-12)
