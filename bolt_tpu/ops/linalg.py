@@ -210,6 +210,16 @@ def svdvals(x, gram_ratio=4):
     return jnp.linalg.svd(x, compute_uv=False)
 
 
+def _check_k(k, d):
+    """Validate a component-count request against ``d`` features; None
+    means all."""
+    if k is None:
+        return d
+    if not 1 <= k <= d:
+        raise ValueError("k=%d out of range for %d features" % (k, d))
+    return k
+
+
 def _gram_decompose(x, k, xp, eigh_fn):
     """Shared Gram-route core for the PCA family: ``x`` is ``(n, d)``,
     returns ``(vec (d, k), ev (k,))`` in descending order.  ``xp`` is the
@@ -242,6 +252,30 @@ def _widen(x, xp):
     if x.dtype in (jnp.bfloat16, jnp.float16):
         return x.astype(jnp.float32)
     return x
+
+
+def tallskinny_svd(x, k=None):
+    """Thin SVD ``(u, s, vh)`` of tall-skinny (batched) matrices via the
+    Gram route: one MXU matmul over the ``(..., n, d)`` data, a (d, d)
+    eigenproblem (batched :func:`jacobi_eigh` when ``d <= 64``), and one
+    more matmul for ``u = x @ v / s``.  Same accuracy trade-off as
+    :func:`svdvals` (condition number squares): singular triplets below
+    ``sqrt(eps) * s_max`` lose accuracy, and for exactly zero singular
+    values the corresponding ``u`` columns are returned as zeros rather
+    than an arbitrary orthonormal completion.  ``k`` truncates to the
+    top components.  Descending order, ``numpy.linalg.svd`` conventions.
+    """
+    x = _widen(jnp.asarray(x), jnp)
+    if x.ndim < 2 or x.shape[-2] < x.shape[-1]:
+        raise ValueError("tallskinny_svd requires (..., n, d) with n >= d, "
+                         "got %s; use jnp.linalg.svd" % (x.shape,))
+    d = x.shape[-1]
+    vec, ev = _gram_decompose(x, _check_k(k, d), jnp, _tpu_eigh)
+    s = jnp.sqrt(ev)
+    safe = jnp.where(s > 0, s, 1.0)
+    u = jnp.matmul(x, vec, precision="highest") / safe[..., None, :]
+    u = jnp.where(s[..., None, :] > 0, u, 0.0)
+    return u, s.astype(_real_dtype(x.dtype)), _adjoint(vec)
 
 
 def tsqr(x):
@@ -340,10 +374,7 @@ def pca(b, k=None, center=False, axis=None):
         raise ValueError(
             "pca requires #samples >= #features (got %d x %d); swap your "
             "key/value axes or use jnp.linalg.svd" % (n, d))
-    if k is None:
-        k = d
-    if not 1 <= k <= d:
-        raise ValueError("k=%d out of range for %d features" % (k, d))
+    k = _check_k(k, d)
 
     if mode == "local":
         # the NumPy oracle: same sequence, host-side
@@ -400,5 +431,5 @@ def tallskinny_pca(x, k=None):
             "matrix would pad the spectrum with zero eigenvalues whose "
             "eigenvectors are arbitrary; use jnp.linalg.svd" % (n, d, n))
     x = _widen(jnp.asarray(x), jnp)
-    vec, ev = _gram_decompose(x, d if k is None else k, jnp, _tpu_eigh)
+    vec, ev = _gram_decompose(x, _check_k(k, d), jnp, _tpu_eigh)
     return vec.astype(x.dtype), jnp.sqrt(ev).astype(_real_dtype(x.dtype))

@@ -153,3 +153,47 @@ def test_tsqr_f32_and_int_and_errors():
     assert np.issubdtype(np.asarray(qi).dtype, np.floating)
     with pytest.raises(ValueError):
         tsqr(jnp.zeros((4, 8)))
+
+
+def test_tallskinny_svd_matches_numpy():
+    from bolt_tpu.ops import tallskinny_svd
+    rs = np.random.RandomState(8)
+    for shape in [(128, 10), (4, 96, 6)]:
+        x = rs.randn(*shape)
+        u, s, vh = (np.asarray(a) for a in tallskinny_svd(jnp.asarray(x)))
+        d = shape[-1]
+        # reconstruction, orthonormality, descending spectrum
+        assert np.allclose(u * s[..., None, :] @ vh, x, atol=1e-9)
+        eye = np.broadcast_to(np.eye(d), s.shape[:-1] + (d, d))
+        assert np.allclose(np.swapaxes(u, -1, -2) @ u, eye, atol=1e-8)
+        assert np.allclose(s, np.linalg.svd(x, compute_uv=False), rtol=1e-9)
+    # truncation
+    x = rs.randn(64, 8)
+    u, s, vh = tallskinny_svd(jnp.asarray(x), k=3)
+    assert u.shape == (64, 3) and s.shape == (3,) and vh.shape == (3, 8)
+    assert np.allclose(np.asarray(s),
+                       np.linalg.svd(x, compute_uv=False)[:3], rtol=1e-9)
+
+
+def test_tallskinny_svd_rank_deficient_and_errors():
+    from bolt_tpu.ops import tallskinny_svd
+    rs = np.random.RandomState(9)
+    # rank-1 input: zero singular values give zero u columns, not NaN
+    col = rs.randn(40, 1)
+    x = col @ rs.randn(1, 5)
+    u, s, vh = (np.asarray(a) for a in tallskinny_svd(jnp.asarray(x)))
+    assert np.all(np.isfinite(u)) and np.all(np.isfinite(s))
+    assert np.allclose(s[1:], 0.0, atol=1e-6 * s[0])
+    assert np.allclose(u * s[None, :] @ vh, x, atol=1e-8 * abs(x).max())
+    with pytest.raises(ValueError):
+        tallskinny_svd(jnp.zeros((4, 8)))
+
+
+def test_component_count_validated_across_family():
+    from bolt_tpu.ops import tallskinny_pca, tallskinny_svd
+    x = jnp.asarray(np.random.RandomState(10).randn(20, 5))
+    for bad in (-1, 0, 99):
+        with pytest.raises(ValueError):
+            tallskinny_svd(x, k=bad)
+        with pytest.raises(ValueError):
+            tallskinny_pca(x, k=bad)

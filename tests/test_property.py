@@ -206,7 +206,7 @@ def test_pca_matches_numpy(mesh, n_extra, d, seed, center):
 
 @given(st.integers(1, 12), st.integers(0, 2 ** 16))
 @settings(**SETTINGS)
-def test_tsqr_properties(mesh, d, seed):
+def test_tsqr_properties(d, seed):
     from bolt_tpu.ops import tsqr
     import jax.numpy as jnp
     rs = np.random.RandomState(seed)
