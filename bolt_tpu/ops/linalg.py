@@ -19,7 +19,8 @@ almost entirely serial.  This module takes the TPU-native route instead:
 * :func:`svdvals` / :func:`tallskinny_pca` — singular values / principal
   components of tall-skinny blocks via the Gram matrix: the (n, d) data
   is touched once by an MXU matmul and the eigenproblem is only (d, d),
-  solved by :func:`jacobi_eigh` when d is small.
+  routed to :func:`jacobi_eigh` when the batch is large enough to
+  amortise the sweep chain (see ``_use_jacobi``), else XLA's QDWH.
 
 Rotation angles use ``0.5 * atan2(2*a_pq, a_qq - a_pp)`` — no divisions,
 no overflow for any input scale (the textbook ``tau = (a_qq - a_pp) /
@@ -36,6 +37,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from bolt_tpu.utils import prod
 
 
 def _adjoint(x):
@@ -175,13 +178,45 @@ def jacobi_eigh(a, vectors=False, sweeps=None):
     return jnp.take_along_axis(w, order, axis=-1), V
 
 
-# past this, the Gram-route eigenproblem is better served by QDWH eigh
+# Jacobi-vs-QDWH routing, measured on a v5e chip (batched Gram matrices,
+# steady state): jacobi wins 2.5-4.5x for d <= 64 at batch >= 1024 and
+# still ~1.2-1.4x at batch 64 for d in [32, 64], but LOSES for small
+# batch*d (the sequential sweep chain is launch-bound: d=16/batch=64 ->
+# 0.6x) and for d = 128 (0.3x — per-step O(B d^2) gathers outgrow QDWH's
+# matmuls).  Hence: small dims AND enough total work.
 _JACOBI_MAX_DIM = 64
+_JACOBI_MIN_WORK = 2048          # batch * d below this -> QDWH
+
+
+def _is_batch_tracer(g):
+    # jax 0.9 deprecates jax.interpreters.batching.BatchTracer (attribute
+    # access raises), so isinstance-check via _src with a name-scan
+    # fallback; if both ever fail the routing degrades to the (correct,
+    # slower-for-vmapped-grams) QDWH path, never to a wrong result
+    try:
+        from jax._src.interpreters import batching
+        if isinstance(g, batching.BatchTracer):
+            return True
+    except Exception:
+        pass
+    return any(c.__name__ == "BatchTracer" for c in type(g).__mro__)
+
+
+def _use_jacobi(g):
+    d = g.shape[-1]
+    if d > _JACOBI_MAX_DIM or jnp.iscomplexobj(g):
+        return False
+    # under vmap the outer batch is invisible in g.shape (the per-chunk
+    # svdvals usage — BASELINE config 5b — maps over the chunk grid, so a
+    # single (d, d) Gram here is really a whole batch of them): a batching
+    # tracer implies the amortisation the work threshold looks for
+    if _is_batch_tracer(g):
+        return True
+    return prod(g.shape[:-2]) * d >= _JACOBI_MIN_WORK
 
 
 def _gram_eigvalsh(g):
-    return jacobi_eigh(g) if g.shape[-1] <= _JACOBI_MAX_DIM \
-        else jnp.linalg.eigvalsh(g)
+    return jacobi_eigh(g) if _use_jacobi(g) else jnp.linalg.eigvalsh(g)
 
 
 def svdvals(x, gram_ratio=4):
@@ -191,8 +226,9 @@ def svdvals(x, gram_ratio=4):
     the reference's PCA workload (``BASELINE`` config 5: per-chunk SVD on
     ``(N, features)``) — the values come from the Gram matrix:
     ``sqrt(eigvalsh(x.T @ x))``.  The matmul runs on the MXU, and the
-    eigendecomposition touches only a (cols, cols) matrix — solved by the
-    batched :func:`jacobi_eigh` when cols <= 64 — instead of XLA's
+    eigendecomposition touches only a (cols, cols) matrix — routed to the
+    batched :func:`jacobi_eigh` when cols <= 64 and the batch (or a
+    vmapped context) amortises it, else XLA's QDWH — instead of XLA's
     QR-iteration SVD over the full block.  The trade-off is the classic
     one: forming the Gram matrix squares the condition number, so trailing
     singular values below ``sqrt(eps) * s_max`` lose accuracy — fine for
@@ -238,7 +274,7 @@ def _gram_decompose(x, k, xp, eigh_fn):
 
 
 def _tpu_eigh(g):
-    if g.shape[-1] <= _JACOBI_MAX_DIM and not jnp.iscomplexobj(g):
+    if _use_jacobi(g):
         return jacobi_eigh(g, vectors=True)
     return jnp.linalg.eigh(g)
 
@@ -257,8 +293,9 @@ def _widen(x, xp):
 def tallskinny_svd(x, k=None):
     """Thin SVD ``(u, s, vh)`` of tall-skinny (batched) matrices via the
     Gram route: one MXU matmul over the ``(..., n, d)`` data, a (d, d)
-    eigenproblem (batched :func:`jacobi_eigh` when ``d <= 64``), and one
-    more matmul for ``u = x @ v / s``.  Same accuracy trade-off as
+    eigenproblem (:func:`jacobi_eigh` when ``d <= 64`` and the batch
+    amortises it — see ``_use_jacobi``), and one more matmul for
+    ``u = x @ v / s``.  Same accuracy trade-off as
     :func:`svdvals` (condition number squares): singular triplets below
     ``sqrt(eps) * s_max`` lose accuracy, and for exactly zero singular
     values the corresponding ``u`` columns are returned as zeros rather
@@ -332,7 +369,8 @@ def pca(b, k=None, center=False, axis=None):
     ``X^T X`` is one MXU matmul per shard whose partial products GSPMD
     combines with an ICI all-reduce (the ``rdd.aggregate`` tree of
     SURVEY §3.4, lowered to hardware), the small (d, d) eigenproblem is
-    solved on-device by :func:`jacobi_eigh`, and the projection
+    solved on-device (a single matrix routes to XLA's QDWH eigh; large
+    batches take :func:`jacobi_eigh`), and the projection
     ``X @ V`` runs shard-local.  Scores keep the input's key sharding;
     data never gathers to one device or host.
 
@@ -349,7 +387,7 @@ def pca(b, k=None, center=False, axis=None):
     sharding on TPU); components ``(d, k)`` and singular values ``(k,)``
     are NumPy arrays (descending).
     """
-    from bolt_tpu.utils import prod, tupleize
+    from bolt_tpu.utils import tupleize
 
     mode = getattr(b, "mode", None)
     if mode not in ("local", "tpu"):
@@ -419,8 +457,9 @@ def pca(b, k=None, center=False, axis=None):
 
 def tallskinny_pca(x, k=None):
     """Principal components of a tall-skinny ``(n, d)`` matrix via the
-    Gram route: eigendecompose ``x.T @ x`` (d x d, MXU matmul; batched
-    Jacobi when d <= 64), return ``(components (d, k), singular_values
+    Gram route: eigendecompose ``x.T @ x`` (d x d, MXU matmul; Jacobi
+    when ``_use_jacobi`` says the shape profits), return
+    ``(components (d, k), singular_values
     (k,))`` in descending order.  The reference runs this workload as
     per-chunk SVD through Spark (``BASELINE`` config 5); here the big
     matmul is the only pass over the data."""

@@ -142,3 +142,33 @@ def test_exotic_dtype_parity(mesh):
 
     xu = rs.randint(0, 255, (8, 4)).astype(np.uint8)
     assert (bolt.array(xu, mesh).map(lambda v: v // 2).toarray() == xu // 2).all()
+
+
+def test_filter_of_filter_chains_pending(mesh):
+    # the second filter consumes a still-pending first filter
+    rs = np.random.RandomState(31)
+    x = rs.randn(16, 6, 4)
+    b = bolt.array(x, mesh, axis=(0,))
+    ff = b.filter(lambda v: v.mean() > -10).filter(lambda v: v.sum() > 0)
+    keep = x[x.reshape(16, -1).sum(axis=1) > 0]
+    assert ff.shape == keep.shape
+    assert np.allclose(ff.toarray(), keep)
+
+
+def test_pending_filter_into_map_sum_without_shape_read(mesh):
+    # consumers must work off the pending result without a host sync first
+    rs = np.random.RandomState(32)
+    x = rs.randn(16, 6, 4)
+    b = bolt.array(x, mesh, axis=(0,))
+    f = b.filter(lambda v: v.mean() > 0)
+    r = f.map(lambda v: v * 0 + 1).sum(axis=(0,))
+    expect = np.ones((6, 4)) * (x.mean(axis=(1, 2)) > 0).sum()
+    assert np.allclose(r.toarray(), expect)
+
+
+def test_operator_expressions_on_deferred_chain(mesh):
+    rs = np.random.RandomState(33)
+    x = rs.randn(8, 5)
+    b = bolt.array(x, mesh, axis=(0,))
+    e = (2.0 * b.map(lambda v: v + 1) - 1.0) / 4.0
+    assert np.allclose(e.toarray(), (2 * (x + 1) - 1) / 4)

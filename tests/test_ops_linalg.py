@@ -197,3 +197,30 @@ def test_component_count_validated_across_family():
             tallskinny_svd(x, k=bad)
         with pytest.raises(ValueError):
             tallskinny_pca(x, k=bad)
+
+
+def test_jacobi_routing_branches():
+    # the Jacobi-vs-QDWH route: big batches and vmapped contexts take
+    # Jacobi; single small matrices and d > 64 take QDWH
+    import jax
+    from bolt_tpu.ops.linalg import _use_jacobi
+    rs = np.random.RandomState(12)
+    small = jnp.asarray(np.eye(8))
+    assert not _use_jacobi(small)                      # batch*d = 8 < 2048
+    big_batch = jnp.zeros((512, 8, 8))
+    assert _use_jacobi(big_batch)                      # 512*8 >= 2048
+    assert not _use_jacobi(jnp.zeros((4, 128, 128)))   # d > 64
+    seen = []
+    jax.vmap(lambda g: seen.append(_use_jacobi(g)) or g)(jnp.zeros((4, 8, 8)))
+    assert seen == [True]                              # vmapped: batched
+
+    # correctness through each route (svdvals under vmap = config 5b path)
+    x = rs.randn(32, 1024, 16).astype(np.float32)
+    from bolt_tpu.ops import svdvals
+    got = np.asarray(jax.jit(jax.vmap(svdvals))(jnp.asarray(x)))
+    expect = np.stack([np.linalg.svd(m.astype(np.float64), compute_uv=False)
+                       for m in x])
+    assert np.allclose(got, expect, rtol=1e-3, atol=1e-2)
+    # big-batch eager route
+    got2 = np.asarray(svdvals(jnp.asarray(x)))
+    assert np.allclose(got2, expect, rtol=1e-3, atol=1e-2)
