@@ -202,17 +202,26 @@ def _is_batch_tracer(g):
     return any(c.__name__ == "BatchTracer" for c in type(g).__mro__)
 
 
+def _true_batch(g):
+    """Total batch count including vmapped dims: under vmap the outer
+    batch is invisible in ``g.shape`` (the per-chunk svdvals usage —
+    BASELINE config 5b — maps over the chunk grid, so a single (d, d)
+    Gram at trace time is really a whole batch of them); walk the
+    batching tracers to recover the true amortisation."""
+    batch = prod(g.shape[:-2])
+    t = g
+    while _is_batch_tracer(t) and hasattr(t, "val"):
+        inner = t.val
+        batch *= max(prod(inner.shape) // max(prod(t.shape), 1), 1)
+        t = inner
+    return batch
+
+
 def _use_jacobi(g):
     d = g.shape[-1]
     if d > _JACOBI_MAX_DIM or jnp.iscomplexobj(g):
         return False
-    # under vmap the outer batch is invisible in g.shape (the per-chunk
-    # svdvals usage — BASELINE config 5b — maps over the chunk grid, so a
-    # single (d, d) Gram here is really a whole batch of them): a batching
-    # tracer implies the amortisation the work threshold looks for
-    if _is_batch_tracer(g):
-        return True
-    return prod(g.shape[:-2]) * d >= _JACOBI_MIN_WORK
+    return _true_batch(g) * d >= _JACOBI_MIN_WORK
 
 
 def _gram_eigvalsh(g):

@@ -7,15 +7,17 @@ part 6).  This script launches TWO OS processes, each owning 4 virtual CPU
 devices, forms the 8-device global mesh, and runs construct → map → sum →
 Welford stats → toarray across it — collectives ride the (simulated) DCN.
 
-Run directly: ``python scripts/multihost_smoke.py``
+Run directly: ``python scripts/multihost_smoke.py`` (scale up with
+``SMOKE_NPROC=4 SMOKE_DEVS=2``).
 """
 
 import os
 import subprocess
 import sys
 
-NPROC = 2
-DEVS_PER_PROC = 4
+# override with SMOKE_NPROC / SMOKE_DEVS for wider topologies
+NPROC = int(os.environ.get("SMOKE_NPROC", "2"))
+DEVS_PER_PROC = int(os.environ.get("SMOKE_DEVS", "4"))
 
 
 def _free_port():
@@ -40,12 +42,17 @@ def worker(pid):
     import bolt_tpu as bolt
     from bolt_tpu.parallel import make_mesh
 
-    assert len(jax.devices()) == NPROC * DEVS_PER_PROC, jax.devices()
-    mesh = make_mesh((NPROC * DEVS_PER_PROC,), ("k",))
+    ndev = NPROC * DEVS_PER_PROC
+    assert len(jax.devices()) == ndev, jax.devices()
+    mesh = make_mesh((ndev,), ("k",))
 
-    x = np.arange(8 * 6 * 4, dtype=np.float64).reshape(8, 6, 4)
+    # the key axis scales with the topology so any SMOKE_NPROC/SMOKE_DEVS
+    # combination shards cleanly
+    nkeys = 2 * ndev
+    x = np.arange(nkeys * 6 * 4, dtype=np.float64).reshape(nkeys, 6, 4)
     b = bolt.array(x, mesh)
-    assert not b._data.is_fully_addressable
+    if NPROC > 1:
+        assert not b._data.is_fully_addressable
 
     m = b.map(lambda v: v * 2 + 1)
     total = m.sum(axis=(0, 1, 2))
@@ -57,7 +64,7 @@ def worker(pid):
     assert np.allclose(np.asarray(st.mean()), x.mean(axis=0))
 
     s = b.swap((0,), (1,))
-    assert s.shape == (4, 8, 6)
+    assert s.shape == (4, nkeys, 6)
 
     full = m.toarray()  # cross-host allgather path
     assert np.allclose(full, x * 2 + 1)

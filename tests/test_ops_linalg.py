@@ -211,8 +211,8 @@ def test_jacobi_routing_branches():
     assert _use_jacobi(big_batch)                      # 512*8 >= 2048
     assert not _use_jacobi(jnp.zeros((4, 128, 128)))   # d > 64
     seen = []
-    jax.vmap(lambda g: seen.append(_use_jacobi(g)) or g)(jnp.zeros((4, 8, 8)))
-    assert seen == [True]                              # vmapped: batched
+    jax.vmap(lambda g: seen.append(_use_jacobi(g)) or g)(jnp.zeros((512, 8, 8)))
+    assert seen == [True]                              # vmapped big batch
 
     # correctness through each route (svdvals under vmap = config 5b path)
     x = rs.randn(32, 1024, 16).astype(np.float32)
@@ -224,3 +224,22 @@ def test_jacobi_routing_branches():
     # big-batch eager route
     got2 = np.asarray(svdvals(jnp.asarray(x)))
     assert np.allclose(got2, expect, rtol=1e-3, atol=1e-2)
+
+
+def test_jacobi_routing_true_batch_under_vmap():
+    # a small vmapped batch must NOT force the Jacobi route: the true
+    # batch (outer vmap dims included) feeds the work threshold
+    import jax
+    from bolt_tpu.ops.linalg import _use_jacobi, _true_batch
+    seen = {}
+    def probe(tag):
+        def f(g):
+            seen[tag] = (_true_batch(g), _use_jacobi(g))
+            return g
+        return f
+    jax.vmap(probe("small"))(jnp.zeros((4, 8, 8)))
+    assert seen["small"] == (4, False)                  # 4*8 < 2048
+    jax.vmap(probe("big"))(jnp.zeros((512, 8, 8)))
+    assert seen["big"] == (512, True)                   # 512*8 >= 2048
+    jax.vmap(jax.vmap(probe("nested")))(jnp.zeros((32, 16, 8, 8)))
+    assert seen["nested"] == (512, True)                # nested vmaps compose
