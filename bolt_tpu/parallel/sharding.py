@@ -14,27 +14,59 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def key_spec(mesh, shape, split):
-    """A ``PartitionSpec`` sharding the leading ``split`` key axes over the
-    mesh.
+def spec_names(entry):
+    """The mesh-axis names of one ``PartitionSpec`` entry as a tuple
+    (entries are ``None``, one name, or a tuple of names)."""
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
 
-    Mesh axes are assigned to key axes greedily in order: each key axis takes
-    the first unused mesh axis whose size divides it.  Unassigned axes (all
-    value axes, and key axes nothing divides) are replicated — the exact
-    analog of the reference's "records spread over partitions, block local to
-    a worker".
+
+def key_spec(mesh, shape, split, reserved=()):
+    """A ``PartitionSpec`` sharding the leading ``split`` key axes over the
+    mesh.  ``reserved`` mesh axes are never assigned (they belong to an
+    explicit value-axis shard — see :func:`combined_spec`).
+
+    Mesh axes are assigned to key axes greedily in order, and a key axis
+    keeps absorbing further unused mesh axes while their combined size
+    still divides it — so a single key axis on a multi-axis mesh shards
+    over the WHOLE mesh (entry = a tuple of names) instead of leaving
+    devices idle.  Unassigned axes (all value axes, and key axes nothing
+    divides) are replicated — the exact analog of the reference's
+    "records spread over partitions, block local to a worker".
     """
     spec = [None] * len(shape)
     if mesh is not None:
-        used = set()
+        assigned = [[] for _ in range(split)]
+        width = [1] * split
+        used = set(reserved)
+        # pass 1: one mesh axis per key axis, in order (every key axis
+        # gets a chance before any axis takes a second)
         for i in range(split):
             for name in mesh.axis_names:
                 if name in used or mesh.shape[name] <= 1:
                     continue
                 if shape[i] % mesh.shape[name] == 0:
-                    spec[i] = name
+                    assigned[i].append(name)
+                    width[i] = mesh.shape[name]
                     used.add(name)
                     break
+        # pass 2: leftover mesh axes are absorbed where divisibility still
+        # holds, so e.g. a lone key axis spreads over the WHOLE mesh
+        for name in mesh.axis_names:
+            if name in used or mesh.shape[name] <= 1:
+                continue
+            for i in range(split):
+                if assigned[i] and shape[i] % (width[i] * mesh.shape[name]) == 0:
+                    assigned[i].append(name)
+                    width[i] *= mesh.shape[name]
+                    used.add(name)
+                    break
+        for i in range(split):
+            if len(assigned[i]) == 1:
+                spec[i] = assigned[i][0]
+            elif assigned[i]:
+                spec[i] = tuple(assigned[i])
     return P(*spec)
 
 
@@ -46,9 +78,12 @@ def combined_spec(mesh, shape, split, value_axes=None):
     contiguous dimension itself is split across devices (the reference
     scales such axes past one worker's memory with ``ChunkedArray`` blocks;
     SURVEY §2.4 maps that to value-axis sharding on the mesh)."""
-    spec = list(key_spec(mesh, shape, split))
+    # reserve the explicitly requested mesh axes so key-axis absorption
+    # cannot steal them
+    reserved = tuple(value_axes.values()) if value_axes else ()
+    spec = list(key_spec(mesh, shape, split, reserved=reserved))
     if value_axes:
-        used = {s for s in spec if s is not None}
+        used = {n for s in spec for n in spec_names(s)}
         for va, name in value_axes.items():
             ax = split + va
             if ax < split or ax >= len(shape):

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from bolt_tpu.parallel.sharding import key_spec
+from bolt_tpu.parallel.sharding import key_spec, spec_names
 from bolt_tpu.statcounter import StatCounter
 from bolt_tpu.utils import prod, tupleize
 
@@ -53,7 +53,8 @@ def welford(barray, requested=("mean", "var", "std", "min", "max"),
     shape = barray.shape
     spec = tuple(key_spec(mesh, shape, split))
     # mesh axes assigned to the reduced dims participate in the collectives
-    reduce_names = tuple(spec[a] for a in axes if spec[a] is not None)
+    # (a spec entry may carry SEVERAL mesh axes — flatten for psum)
+    reduce_names = tuple(n for a in axes for n in spec_names(spec[a]))
     out_spec = P(*(spec[i] for i in range(len(shape)) if i not in axes))
     n_total = prod(tuple(shape[a] for a in axes))
 

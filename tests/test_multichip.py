@@ -23,8 +23,27 @@ def test_key_spec_assignment(mesh, mesh2d):
     assert tuple(key_spec(mesh, (7, 4), 1)) == (None, None)
     # 2-d mesh: greedy in-order assignment
     assert tuple(key_spec(mesh2d, (8, 4, 6), 2)) == ("a", "b", None)
-    # only value axes excluded
-    assert tuple(key_spec(mesh2d, (8, 4, 6), 1)) == ("a", None, None)
+    # a single key axis absorbs EVERY divisible mesh axis (8 devices busy,
+    # not 4): the spec entry is a tuple of mesh axes
+    assert tuple(key_spec(mesh2d, (8, 4, 6), 1)) == (("a", "b"), None, None)
+    # absorption stops when the combined width stops dividing
+    assert tuple(key_spec(mesh2d, (4, 4, 6), 1)) == ("a", None, None)
+    # 4 % 4 == 0 takes 'a'; next axis 4 % 2 == 0 takes 'b'
+    assert tuple(key_spec(mesh2d, (4, 4, 6), 2)) == ("a", "b", None)
+
+
+def test_single_key_axis_uses_whole_2d_mesh(mesh2d):
+    # end to end: one key axis on the (4, 2) mesh spreads over all 8
+    # devices, and collectives still produce oracle answers
+    x = _x((16, 4, 6))
+    b = bolt.array(x, mesh2d, axis=(0,))
+    assert len(b._data.addressable_shards) == 8
+    assert all(s.data.shape == (2, 4, 6) for s in b._data.addressable_shards)
+    assert allclose(b.map(lambda v: v + 1).sum(axis=(0,)).toarray(),
+                    (x + 1).sum(axis=0))
+    st = b.stats()
+    assert np.allclose(np.asarray(st.mean()), x.mean(axis=0))
+    assert np.allclose(np.asarray(st.stdev()), x.std(axis=0), atol=1e-9)
 
 
 def test_data_actually_distributed(mesh):

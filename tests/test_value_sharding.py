@@ -22,8 +22,13 @@ def _x(shape=(4, 16, 6)):
 def test_combined_spec(mesh2d):
     spec = combined_spec(mesh2d, (4, 16, 6), 1, {0: "b"})
     assert tuple(spec) == ("a", "b", None)
+    # an explicit value request wins: the key assignment yields 'a' and
+    # takes 'b' instead (reservation-first; used to be an error)
+    spec = combined_spec(mesh2d, (4, 16, 6), 1, {0: "a"})
+    assert tuple(spec) == ("b", "a", None)
     with pytest.raises(ValueError):
-        combined_spec(mesh2d, (4, 16, 6), 1, {0: "a"})  # already assigned
+        # two value axes asking for the same mesh axis IS an error
+        combined_spec(mesh2d, (4, 16, 6), 1, {0: "b", 1: "b"})
     with pytest.raises(ValueError):
         combined_spec(mesh2d, (4, 15, 6), 1, {0: "b"})  # 15 % 2 != 0
     with pytest.raises(ValueError):
@@ -146,3 +151,21 @@ def test_exchange_halo_validation(mesh):
     with pytest.raises(ValueError):
         jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
                               out_specs=P("k")))(jnp.ones(16))
+
+
+def test_value_shard_survives_key_axis_absorption(mesh2d):
+    # a lone key axis would absorb BOTH mesh axes; an explicit value-axis
+    # shard reserves its mesh axis so chunk.shard still works
+    import numpy as np
+    from bolt_tpu.parallel.sharding import combined_spec, key_spec
+    spec = combined_spec(mesh2d, (8, 4, 6), 1, {0: "b"})
+    assert tuple(spec) == ("a", "b", None)
+    # and without the reservation the key axis takes the whole mesh
+    assert tuple(key_spec(mesh2d, (8, 4, 6), 1)) == (("a", "b"), None, None)
+    # end to end through the public chunk API
+    x = np.random.RandomState(20).randn(8, 4, 6)
+    b = bolt.array(x, mesh2d, axis=(0,))
+    cs = b.chunk(size=(2,), axis=(0,)).shard("b", axis=0)
+    assert cs.vshard == {0: "b"}
+    out = cs.map(lambda blk: blk * 2.0).unchunk()
+    assert np.allclose(out.toarray(), x * 2.0)
