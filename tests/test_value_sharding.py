@@ -156,8 +156,7 @@ def test_exchange_halo_validation(mesh):
 def test_value_shard_survives_key_axis_absorption(mesh2d):
     # a lone key axis would absorb BOTH mesh axes; an explicit value-axis
     # shard reserves its mesh axis so chunk.shard still works
-    import numpy as np
-    from bolt_tpu.parallel.sharding import combined_spec, key_spec
+    from bolt_tpu.parallel.sharding import key_spec
     spec = combined_spec(mesh2d, (8, 4, 6), 1, {0: "b"})
     assert tuple(spec) == ("a", "b", None)
     # and without the reservation the key axis takes the whole mesh

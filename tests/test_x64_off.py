@@ -1,0 +1,77 @@
+"""TPU-production dtype mode: x64 DISABLED (the suite's conftest enables
+x64 for bit-parity with the NumPy oracle; real TPU sessions run without
+it, where float64 requests canonicalise to float32 at construction —
+docs/MIGRATION.md "Dtypes").  Runs in a subprocess so the main process's
+x64 config is untouched."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_SCRIPT = r"""
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+assert not jax.config.jax_enable_x64
+
+import numpy as np
+import bolt_tpu as bolt
+
+mesh = jax.make_mesh((8,), ("k",))
+x64 = np.random.RandomState(0).randn(64, 6, 4)          # float64 input
+
+b = bolt.array(x64, mesh, axis=(0,))
+assert b.dtype == np.float32, b.dtype                   # canonicalised
+x32 = x64.astype(np.float32)
+assert np.array_equal(b.toarray(), x32)
+
+# the full pipeline stays f32 and matches the f32 oracle
+m = b.map(lambda v: v * 2 + 1)
+assert m.dtype == np.float32
+assert np.allclose(m.toarray(), x32 * 2 + 1)
+# f32-only accumulation differs from numpy's pairwise order by a few
+# ulps: tolerance reflects the documented non-bit-exact f32 mode
+assert np.allclose(np.asarray(b.mean(axis=(0,)).toarray()),
+                   x32.mean(axis=0), rtol=1e-5, atol=1e-6)
+st = b.stats()
+assert np.allclose(np.asarray(st.mean()), x32.mean(axis=0),
+                   rtol=1e-5, atol=1e-6)
+
+s = b.swap((0,), (0,))
+assert s.dtype == np.float32
+
+f = b.filter(lambda v: v.mean() > 0)
+keep = x32[x32.mean(axis=(1, 2)) > 0]
+assert f.shape == keep.shape and np.allclose(f.toarray(), keep)
+
+# constructors: f64 request comes back f32, ints survive untouched
+o = bolt.ones((8, 4), mesh, dtype=np.float64)
+assert o.dtype == np.float32
+i = bolt.array(np.arange(8, dtype=np.int64).reshape(8, 1), mesh)
+assert i.dtype == np.int32                              # jax canonical int
+
+# linalg family under f32-only
+from bolt_tpu.ops import pca, tallskinny_svd
+scores, comps, svals = pca(b.map(lambda v: v.reshape(24)), k=2)
+ref = np.linalg.svd(x32.reshape(64, 24).astype(np.float64),
+                    compute_uv=False)[:2]
+assert np.allclose(svals, ref, rtol=1e-4)
+u, s_, vh = tallskinny_svd(np.asarray(x64.reshape(384, 4)))
+assert np.asarray(u).dtype == np.float32
+
+print("X64-OFF-OK")
+"""
+
+
+def test_pipeline_without_x64():
+    env = dict(os.environ)
+    env.pop("JAX_ENABLE_X64", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""       # no TPU plugin in the subprocess
+    out = subprocess.run([sys.executable, "-c", _SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "X64-OFF-OK" in out.stdout
