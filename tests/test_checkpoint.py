@@ -49,3 +49,31 @@ def test_save_deferred_materialises(tmp_path, mesh):
 def test_save_rejects_local(tmp_path):
     with pytest.raises(TypeError):
         checkpoint.save(str(tmp_path / "c"), bolt.array(_x()))
+
+
+def test_checkpoint_deferred_and_pending_states(mesh, tmp_path):
+    # save() must materialise a deferred chain and resolve a pending
+    # filter; restore round-trips both
+    rs = np.random.RandomState(40)
+    x = rs.randn(16, 4)
+    b = bolt.array(x, mesh).map(lambda v: v * 2)
+    checkpoint.save(str(tmp_path / "a"), b)
+    r = checkpoint.load(str(tmp_path / "a"), context=mesh)
+    assert np.allclose(r.toarray(), x * 2)
+
+    f = bolt.array(x, mesh).filter(lambda v: v.mean() > 0)
+    checkpoint.save(str(tmp_path / "b"), f)
+    r2 = checkpoint.load(str(tmp_path / "b"), context=mesh)
+    keep = x[x.mean(axis=1) > 0]
+    assert r2.shape == keep.shape and np.allclose(r2.toarray(), keep)
+
+
+def test_checkpoint_tuple_spec_sharding(mesh2d, tmp_path):
+    # a lone key axis on a 2-d mesh shards over BOTH axes (tuple spec
+    # entry); orbax must round-trip that layout
+    x = np.random.RandomState(41).randn(16, 4, 6)
+    b = bolt.array(x, mesh2d, axis=(0,))
+    assert len(b._data.addressable_shards) == 8
+    checkpoint.save(str(tmp_path / "c"), b)
+    r = checkpoint.load(str(tmp_path / "c"), context=mesh2d)
+    assert r.split == 1 and np.allclose(r.toarray(), x)

@@ -84,3 +84,20 @@ def test_errors(mesh):
         b[0, 0, 0, 0]
     with pytest.raises(IndexError):
         b[99]
+
+
+def test_negative_and_mixed_index_forms(mesh):
+    # negatives, reversed slices, empty slices, ndarray indices, and
+    # int+list+slice mixes — full numpy-oracle parity
+    rs = np.random.RandomState(50)
+    x = rs.randn(16, 6, 4)
+    b = bolt.array(x, mesh)
+    assert allclose(b[-1].toarray(), x[-1])
+    assert allclose(b[-3:].toarray(), x[-3:])
+    assert allclose(b[..., -2:].toarray(), x[..., -2:])
+    assert allclose(b[[-1, 0, 2]].toarray(), x[[-1, 0, 2]])
+    assert allclose(b[::-1].toarray(), x[::-1])
+    assert allclose(b[np.array([1, 3])].toarray(), x[np.array([1, 3])])
+    assert allclose(np.asarray(b[2, -1, ::2].toarray()), x[2, -1, ::2])
+    assert b[5:2].toarray().shape == x[5:2].shape
+    assert allclose(b[1, [0, 2], :].toarray(), x[1][[0, 2], :])
