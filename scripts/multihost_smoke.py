@@ -69,6 +69,17 @@ def worker(pid):
     full = m.toarray()  # cross-host allgather path
     assert np.allclose(full, x * 2 + 1)
 
+    # whole-array PCA: the Gram partial products combine with an
+    # all-reduce that rides the (simulated) DCN between the processes
+    from bolt_tpu.ops import pca
+    rs = np.random.RandomState(3)
+    px = rs.randn(4 * ndev, 3)
+    pb = bolt.array(px, mesh)
+    scores, comps, svals = pca(pb, k=2, center=True)
+    pxc = px - px.mean(axis=0)
+    assert np.allclose(svals, np.linalg.svd(pxc, compute_uv=False)[:2])
+    assert scores.shape == (4 * ndev, 2)
+
     print("worker %d OK" % pid, flush=True)
 
 

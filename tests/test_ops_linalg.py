@@ -256,14 +256,19 @@ def test_jacobi_is_differentiable():
     _, v = np.linalg.eigh(a)
     assert np.allclose(np.asarray(g2), np.outer(v[:, -1], v[:, -1]),
                        atol=1e-6)
-    # and through the Gram-route svdvals pipeline, vs finite differences
+    # and through the Gram-route svdvals pipeline vs finite differences —
+    # BATCHED so the eigensolve really routes to jacobi_eigh (an unbatched
+    # (6, 6) Gram would take XLA's eigh and test its JVP instead)
     from bolt_tpu.ops import svdvals
-    x = rs.randn(64, 6)
-    g3 = np.asarray(jax.grad(lambda m: svdvals(m).sum())(jnp.asarray(x)))
+    from bolt_tpu.ops.linalg import _use_jacobi
+    assert _use_jacobi(jnp.zeros((400, 6, 6)))
+    x = rs.randn(400, 64, 6)
+    g3 = np.asarray(jax.grad(
+        lambda m: svdvals(m).sum())(jnp.asarray(x)))
     eps = 1e-6
     for i in range(3):
-        xp = x.copy(); xp[0, i] += eps
-        xm = x.copy(); xm[0, i] -= eps
-        num = (np.linalg.svd(xp, compute_uv=False).sum()
-               - np.linalg.svd(xm, compute_uv=False).sum()) / (2 * eps)
-        assert abs(g3[0, i] - num) < 1e-5
+        xp = x.copy(); xp[7, 0, i] += eps
+        xm = x.copy(); xm[7, 0, i] -= eps
+        num = (np.linalg.svd(xp[7], compute_uv=False).sum()
+               - np.linalg.svd(xm[7], compute_uv=False).sum()) / (2 * eps)
+        assert abs(g3[7, 0, i] - num) < 1e-5
