@@ -96,3 +96,19 @@ def test_empty_key_axis(mesh):
     assert b.shape == (0, 3, 2)
     assert b.map(lambda v: v + 1).toarray().shape == (0, 3, 2)
     assert b.filter(lambda v: True).toarray().shape == (0, 3, 2)
+
+
+def test_pipeline_under_disable_jit(mesh):
+    # the debugging mode users reach for first: everything must still
+    # produce oracle answers eagerly
+    import jax
+    rs = np.random.RandomState(60)
+    x = rs.randn(16, 4)
+    with jax.disable_jit():
+        b = bolt.array(x, mesh)
+        assert np.allclose(b.map(lambda v: v + 1).sum(axis=(0,)).toarray(),
+                           (x + 1).sum(axis=0))
+        assert np.allclose(np.asarray(b.stats().mean()), x.mean(axis=0))
+        f = b.filter(lambda v: v.mean() > 0)
+        assert np.allclose(f.toarray(), x[x.mean(axis=1) > 0])
+        assert np.allclose(b.swap((0,), (0,)).toarray(), x.T)
