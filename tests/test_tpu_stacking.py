@@ -59,3 +59,42 @@ def test_stack_map_count_guard(mesh):
 def test_repr(mesh):
     r = repr(bolt.array(_x(), mesh).stacked(size=3))
     assert "nblocks: 3" in r and "size: 3" in r
+
+
+def test_stacked_map_trace_cost_is_grid_independent(mesh):
+    # func must trace at most twice (vmapped full blocks + ragged tail),
+    # not once per block — size=2 over 16 records would otherwise cost 8
+    rs = np.random.RandomState(70)
+    x = rs.randn(16, 3)
+    traces = []
+
+    def f(blk):
+        traces.append(blk.shape)
+        return blk * 2.0
+
+    out = bolt.array(x, mesh).stacked(size=3).map(f).unstack()
+    assert np.allclose(out.toarray(), x * 2.0)
+    assert len(traces) <= 2, traces          # 5 full blocks + tail of 1
+    # uniform split: single vmapped trace
+    traces.clear()
+    out = bolt.array(x, mesh).stacked(size=4).map(f).unstack()
+    assert np.allclose(out.toarray(), x * 2.0)
+    assert len(traces) == 1, traces
+
+
+def test_stack_map_count_guard_both_branches(mesh):
+    rs = np.random.RandomState(71)
+    x = rs.randn(8, 3)
+    # vmap branch: full blocks violate the contract
+    with pytest.raises(ValueError):
+        bolt.array(x, mesh).stacked(size=4).map(lambda blk: blk[:2]).unstack()
+    # ragged-tail branch: a fixed 3-row output satisfies the full blocks
+    # but violates the 2-record tail
+    import jax.numpy as jnp
+    with pytest.raises(ValueError):
+        bolt.array(x, mesh).stacked(size=3).map(
+            lambda blk: jnp.zeros((3,) + blk.shape[1:])).unstack()
+    # record axis dropped entirely
+    with pytest.raises(ValueError):
+        bolt.array(x, mesh).stacked(size=4).map(
+            lambda blk: blk.sum()).unstack()
