@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from bolt_tpu.parallel.sharding import combined_spec
-from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit, _constrain, _traceable
+from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _chain_apply,
+                                _check_live, _constrain, _traceable)
 from bolt_tpu.utils import iterexpand, prod, tupleize
 
 
@@ -243,6 +244,9 @@ class ChunkedArray:
         padded = any(p > 0 for p in pad)
         vshard = dict(self._vshard)
         vs_key = tuple(sorted(vshard.items()))
+        # a deferred chain on the underlying array fuses INTO the chunked
+        # program — no materialised intermediate between map and chunk.map
+        base, funcs = b._chain_parts()
 
         if self.uniform and not padded:
             # decide the OUTPUT's value sharding up front so the returned
@@ -279,6 +283,7 @@ class ChunkedArray:
 
             def build():
                 def run(data):
+                    data = _chain_apply(funcs, split, data)
                     newshape = kshape + tuple(
                         x for v, c in zip(vshape, plan) for x in (v // c, c))
                     r = data.reshape(newshape)
@@ -303,9 +308,10 @@ class ChunkedArray:
                     return _constrain_chunked(out, mesh, split, vshard)
                 return jax.jit(run)
 
-            fn = _cached_jit(("chunk-map-u", func, b.shape, str(b.dtype),
-                             split, plan, vs_key, mesh), build)
-            out = fn(b._data)
+            fn = _cached_jit(("chunk-map-u", func, funcs, base.shape,
+                              str(base.dtype), split, plan, vs_key, mesh),
+                             build)
+            out = fn(_check_live(base))
             new_plan = tuple(o // g for o, g in zip(out.shape[split:], grid))
             return ChunkedArray(BoltArrayTPU(out, split, mesh), new_plan, pad,
                                 vshard)
@@ -321,6 +327,7 @@ class ChunkedArray:
         # per block here, we pay one compiled program.
         def build():
             def run(data):
+                data = _chain_apply(funcs, split, data)
                 axes_cats = [_axis_categories(vshape[i], plan[i], pad[i],
                                               grid[i]) for i in range(nv)]
 
@@ -375,9 +382,10 @@ class ChunkedArray:
                 return _constrain_chunked(out, mesh, split, vshard)
             return jax.jit(run)
 
-        fn = _cached_jit(("chunk-map-g", func, b.shape, str(b.dtype),
-                          split, plan, pad, vs_key, mesh), build)
-        out = fn(b._data)
+        fn = _cached_jit(("chunk-map-g", func, funcs, base.shape,
+                          str(base.dtype), split, plan, pad, vs_key, mesh),
+                         build)
+        out = fn(_check_live(base))
         return ChunkedArray(BoltArrayTPU(out, split, mesh), plan, pad, vshard)
 
     # ------------------------------------------------------------------

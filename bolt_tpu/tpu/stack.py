@@ -16,7 +16,8 @@ blocks in one compiled program.
 import jax
 import jax.numpy as jnp
 
-from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit, _constrain, _traceable
+from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _chain_apply,
+                                _check_live, _constrain, _traceable)
 from bolt_tpu.utils import prod
 
 
@@ -75,10 +76,16 @@ class StackedArray:
         vshape = b.shape[split:]
         n = prod(kshape)
         size = self._size
+        base, funcs = b._chain_parts()
 
         def build():
             def run(data):
+                data = _chain_apply(funcs, split, data)
                 flat = data.reshape((n,) + vshape)
+                if n == 0:
+                    # zero records (a filter with no survivors): func never
+                    # runs; the empty block is its own (empty) result
+                    return _constrain(data, mesh, split)
                 nfull = n // size
                 outs = []
                 if nfull:
@@ -105,9 +112,10 @@ class StackedArray:
                 return _constrain(out, mesh, split)
             return jax.jit(run)
 
-        fn = _cached_jit(("stack-map", func, b.shape, str(b.dtype), split,
-                          size, mesh), build)
-        return StackedArray(BoltArrayTPU(fn(b._data), split, mesh), size)
+        fn = _cached_jit(("stack-map", func, funcs, base.shape,
+                          str(base.dtype), split, size, mesh), build)
+        return StackedArray(BoltArrayTPU(fn(_check_live(base)), split, mesh),
+                            size)
 
     def unstack(self):
         """Back to a :class:`BoltArrayTPU` (reference:

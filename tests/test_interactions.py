@@ -172,3 +172,21 @@ def test_operator_expressions_on_deferred_chain(mesh):
     b = bolt.array(x, mesh, axis=(0,))
     e = (2.0 * b.map(lambda v: v + 1) - 1.0) / 4.0
     assert np.allclose(e.toarray(), (2 * (x + 1) - 1) / 4)
+
+
+def test_chunk_and_stack_maps_fuse_deferred_chains(mesh):
+    # chunk.map / stacked.map pull an unmaterialised chain into their own
+    # program: the source array must STAY deferred (no intermediate in HBM)
+    x = _x()
+    b = bolt.array(x, mesh).map(lambda v: v + 1)
+    assert b.deferred
+    out = b.chunk(size=(2, 3), axis=(0, 1)).map(lambda blk: blk * 2).unchunk()
+    assert b.deferred
+    assert allclose(out.toarray(), (x + 1) * 2)
+    out2 = b.chunk(size=(3,), axis=(0,), padding=1).map(
+        lambda blk: blk * 1.0).unchunk()
+    assert b.deferred
+    assert allclose(out2.toarray(), x + 1)
+    out3 = b.stacked(size=3).map(lambda blk: blk - 1).unstack()
+    assert b.deferred
+    assert allclose(out3.toarray(), x)

@@ -98,3 +98,13 @@ def test_stack_map_count_guard_both_branches(mesh):
     with pytest.raises(ValueError):
         bolt.array(x, mesh).stacked(size=4).map(
             lambda blk: blk.sum()).unstack()
+
+
+def test_stacked_map_zero_records(mesh):
+    # a filter with no survivors yields (0, *vshape); stacked.map must
+    # return the empty result, not crash on an empty concatenate
+    x = np.random.RandomState(72).randn(8, 3)
+    f = bolt.array(x, mesh).filter(lambda v: v.sum() > 1e9)
+    out = f.stacked(size=4).map(lambda blk: blk * 2).unstack()
+    assert out.shape == (0, 3)
+    assert out.toarray().shape == (0, 3)
