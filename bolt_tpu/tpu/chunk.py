@@ -20,13 +20,16 @@ recursive concatenate tree the reference's ``unchunk`` uses — all inside
 one jit whose trace cost is independent of the grid size.
 """
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from bolt_tpu.parallel.sharding import combined_spec
-from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _chain_apply,
-                                _check_live, _constrain, _traceable)
+from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _canon,
+                                _chain_apply, _check_live, _constrain,
+                                _traceable)
 from bolt_tpu.utils import iterexpand, prod, tupleize
 
 
@@ -232,6 +235,22 @@ class ChunkedArray:
         shape so the halo can be trimmed and the tiles reassembled.
         """
         func = _traceable(func)
+        hint_ob = None
+        if value_shape is not None:
+            # reference-parity hint: validate the per-block output shape
+            # (reference ChunkedArray.map accepts the same hint to skip
+            # its run-one-block inference)
+            try:
+                hint_ob = jax.eval_shape(func, jax.ShapeDtypeStruct(
+                    tuple(self._plan), self._barray._aval.dtype))
+            except Exception:
+                hint_ob = None
+            if (hint_ob is not None
+                    and tuple(tupleize(value_shape)) != tuple(hint_ob.shape)):
+                raise ValueError(
+                    "value_shape %s does not match the inferred per-block "
+                    "shape %s" % (tuple(tupleize(value_shape)),
+                                  tuple(hint_ob.shape)))
         b = self._barray
         split = b.split
         mesh = b.mesh
@@ -247,6 +266,7 @@ class ChunkedArray:
         # a deferred chain on the underlying array fuses INTO the chunked
         # program — no materialised intermediate between map and chunk.map
         base, funcs = b._chain_parts()
+        canon = None if dtype is None else _canon(dtype)
 
         if self.uniform and not padded:
             # decide the OUTPUT's value sharding up front so the returned
@@ -256,9 +276,10 @@ class ChunkedArray:
             if vshard:
                 keep = False
                 try:
-                    ob_shape = tuple(jax.eval_shape(
-                        func,
-                        jax.ShapeDtypeStruct(tuple(plan), b._aval.dtype)).shape)
+                    ob_shape = tuple(hint_ob.shape) if hint_ob is not None \
+                        else tuple(jax.eval_shape(
+                            func, jax.ShapeDtypeStruct(
+                                tuple(plan), b._aval.dtype)).shape)
                 except Exception:
                     ob_shape = None
                 if ob_shape is not None and len(ob_shape) == nv:
@@ -305,12 +326,14 @@ class ChunkedArray:
                     out = jnp.transpose(out, perm)
                     merged = kshape + tuple(g * o for g, o in zip(grid, ob))
                     out = out.reshape(merged)
+                    if canon is not None:
+                        out = out.astype(canon)
                     return _constrain_chunked(out, mesh, split, vshard)
                 return jax.jit(run)
 
             fn = _cached_jit(("chunk-map-u", func, funcs, base.shape,
-                              str(base.dtype), split, plan, vs_key, mesh),
-                             build)
+                              str(base.dtype), split, plan, vs_key, canon,
+                              mesh), build)
             out = fn(_check_live(base))
             new_plan = tuple(o // g for o, g in zip(out.shape[split:], grid))
             return ChunkedArray(BoltArrayTPU(out, split, mesh), new_plan, pad,
@@ -379,12 +402,14 @@ class ChunkedArray:
                     return jnp.concatenate(parts, axis=split + level)
 
                 out = assemble([], 0)
+                if canon is not None:
+                    out = out.astype(canon)
                 return _constrain_chunked(out, mesh, split, vshard)
             return jax.jit(run)
 
         fn = _cached_jit(("chunk-map-g", func, funcs, base.shape,
-                          str(base.dtype), split, plan, pad, vs_key, mesh),
-                         build)
+                          str(base.dtype), split, plan, pad, vs_key, canon,
+                          mesh), build)
         out = fn(_check_live(base))
         return ChunkedArray(BoltArrayTPU(out, split, mesh), plan, pad, vshard)
 

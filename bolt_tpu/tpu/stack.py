@@ -13,12 +13,15 @@ thin compatibility view: it exposes the same block-wise ``map`` contract
 blocks in one compiled program.
 """
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
-from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _chain_apply,
-                                _check_live, _constrain, _traceable)
-from bolt_tpu.utils import prod
+from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _canon,
+                                _chain_apply, _check_live, _constrain,
+                                _traceable)
+from bolt_tpu.utils import prod, tupleize
 
 
 class StackedArray:
@@ -77,6 +80,7 @@ class StackedArray:
         n = prod(kshape)
         size = self._size
         base, funcs = b._chain_parts()
+        canon = None if dtype is None else _canon(dtype)
 
         def build():
             def run(data):
@@ -84,8 +88,14 @@ class StackedArray:
                 flat = data.reshape((n,) + vshape)
                 if n == 0:
                     # zero records (a filter with no survivors): func never
-                    # runs; the empty block is its own (empty) result
-                    return _constrain(data, mesh, split)
+                    # runs, but the empty output must still carry the
+                    # value shape/dtype func WOULD produce so empty and
+                    # non-empty branches of one pipeline stay consistent
+                    ob = jax.eval_shape(func, jax.ShapeDtypeStruct(
+                        (size,) + vshape, flat.dtype))
+                    out = jnp.zeros(kshape + tuple(ob.shape[1:]),
+                                    canon or ob.dtype)
+                    return _constrain(out, mesh, split)
                 nfull = n // size
                 outs = []
                 if nfull:
@@ -109,13 +119,20 @@ class StackedArray:
                     outs.append(tout)
                 out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
                 out = out.reshape(kshape + out.shape[1:])
+                if canon is not None:
+                    out = out.astype(canon)   # fused into the same program
                 return _constrain(out, mesh, split)
             return jax.jit(run)
 
         fn = _cached_jit(("stack-map", func, funcs, base.shape,
-                          str(base.dtype), split, size, mesh), build)
-        return StackedArray(BoltArrayTPU(fn(_check_live(base)), split, mesh),
-                            size)
+                          str(base.dtype), split, size, canon, mesh), build)
+        out = fn(_check_live(base))
+        if value_shape is not None and tuple(out.shape[split:]) != tuple(
+                tupleize(value_shape)):
+            raise ValueError(
+                "value_shape %s does not match the mapped value shape %s"
+                % (tuple(tupleize(value_shape)), tuple(out.shape[split:])))
+        return StackedArray(BoltArrayTPU(out, split, mesh), size)
 
     def unstack(self):
         """Back to a :class:`BoltArrayTPU` (reference:

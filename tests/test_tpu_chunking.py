@@ -268,3 +268,18 @@ def test_repr(mesh):
     c = bolt.array(_x(), mesh).chunk(size=(2,), axis=(0,))
     r = repr(c)
     assert "plan" in r and "grid" in r and "padding" in r
+
+
+def test_chunk_map_value_shape_and_dtype_hints(mesh):
+    # reference-parity hints: value_shape validates, dtype casts
+    rs = np.random.RandomState(80)
+    x = rs.randn(8, 6, 4)
+    c = bolt.array(x, mesh).chunk(size=(3,), axis=(0,))
+    out = c.map(lambda blk: blk * 2, dtype=np.float32).unchunk()
+    assert out.dtype == np.float32
+    assert np.allclose(out.toarray(), (x * 2).astype(np.float32))
+    with pytest.raises(ValueError):
+        c.map(lambda blk: blk * 2, value_shape=(9, 9))
+    # a correct hint passes
+    ok = c.map(lambda blk: blk * 2, value_shape=(3, 4)).unchunk()
+    assert np.allclose(ok.toarray(), x * 2)

@@ -102,9 +102,31 @@ def test_stack_map_count_guard_both_branches(mesh):
 
 def test_stacked_map_zero_records(mesh):
     # a filter with no survivors yields (0, *vshape); stacked.map must
-    # return the empty result, not crash on an empty concatenate
+    # return the empty result, not crash on an empty concatenate — and a
+    # value-shape/dtype-changing func must produce the SAME output
+    # shape/dtype the non-empty branch would
     x = np.random.RandomState(72).randn(8, 3)
     f = bolt.array(x, mesh).filter(lambda v: v.sum() > 1e9)
     out = f.stacked(size=4).map(lambda blk: blk * 2).unstack()
     assert out.shape == (0, 3)
     assert out.toarray().shape == (0, 3)
+    out2 = f.stacked(size=4).map(lambda blk: blk[:, :1]).unstack()
+    assert out2.shape == (0, 1)
+    import jax.numpy as jnp
+    out3 = f.stacked(size=4).map(
+        lambda blk: blk.astype(jnp.float32)).unstack()
+    assert out3.dtype == np.float32
+    out4 = f.stacked(size=4).map(lambda blk: blk * 2, dtype=np.float32
+                                 ).unstack()
+    assert out4.dtype == np.float32 and out4.shape == (0, 3)
+
+
+def test_stacked_map_value_shape_and_dtype_hints(mesh):
+    rs = np.random.RandomState(81)
+    x = rs.randn(8, 3)
+    s = bolt.array(x, mesh).stacked(size=4)
+    out = s.map(lambda blk: blk + 1, dtype=np.float32).unstack()
+    assert out.dtype == np.float32
+    assert np.allclose(out.toarray(), (x + 1).astype(np.float32), atol=1e-6)
+    with pytest.raises(ValueError):
+        s.map(lambda blk: blk + 1, value_shape=(7,))
