@@ -102,6 +102,16 @@ def _traceable(func):
     return func
 
 
+def _check_value_shape(hint, inferred):
+    """Validate an explicit ``value_shape`` hint against the inferred
+    per-record output shape (shared by the array/chunked/stacked maps)."""
+    if hint is None or inferred is None:
+        return
+    if tuple(tupleize(hint)) != tuple(inferred):
+        raise ValueError("value_shape %s does not match inferred %s"
+                         % (tuple(tupleize(hint)), tuple(inferred)))
+
+
 def _canon(dtype):
     """Canonicalise a dtype to what the backend can hold (f64→f32 unless
     x64 is enabled) — explicit and silent rather than warn-and-truncate."""
@@ -338,10 +348,7 @@ class BoltArrayTPU(BoltArray):
             return self._constructor.array(
                 local.toarray(), context=self._mesh, axis=tuple(range(split)))
 
-        if value_shape is not None and tuple(tupleize(value_shape)) != tuple(out_aval.shape):
-            raise ValueError(
-                "value_shape %s does not match inferred %s"
-                % (tuple(tupleize(value_shape)), tuple(out_aval.shape)))
+        _check_value_shape(value_shape, tuple(out_aval.shape))
 
         mesh = self._mesh
         full_aval = jax.ShapeDtypeStruct(kshape + tuple(out_aval.shape),
@@ -809,21 +816,36 @@ class BoltArrayTPU(BoltArray):
             return self
         mesh = self._mesh
 
+        if not donate:
+            # a deferred chain fuses into the transpose program (donation
+            # keeps materialise-first semantics: the chain's BASE buffer
+            # may be aliased by other arrays, so it must not be donated)
+            base, funcs = self._chain_parts()
+
+            def build():
+                def swapper(data):
+                    mapped = _chain_apply(funcs, split, data)
+                    return _constrain(jnp.transpose(mapped, perm), mesh,
+                                      new_split)
+                return jax.jit(swapper)
+
+            fn = _cached_jit(("swap", funcs, base.shape, str(base.dtype),
+                              tuple(perm), split, new_split, False, mesh),
+                             build)
+            return self._wrap(fn(_check_live(base)), new_split)
+
         def build():
             def swapper(data):
                 return _constrain(jnp.transpose(data, perm), mesh, new_split)
-            if donate:
-                return jax.jit(swapper, donate_argnums=(0,))
-            return jax.jit(swapper)
+            return jax.jit(swapper, donate_argnums=(0,))
 
         fn = _cached_jit(("swap", self.shape, str(self.dtype), tuple(perm),
-                          split, new_split, donate, mesh), build)
+                          split, new_split, True, mesh), build)
         out = fn(self._data)
-        if donate:
-            # only after a successful dispatch: a compile failure must not
-            # brick an array whose buffer was never consumed
-            self._concrete = None
-            self._donated = True
+        # only after a successful dispatch: a compile failure must not
+        # brick an array whose buffer was never consumed
+        self._concrete = None
+        self._donated = True
         return self._wrap(out, new_split)
 
     def chunk(self, size="150", axis=None, padding=None):

@@ -20,16 +20,14 @@ recursive concatenate tree the reference's ``unchunk`` uses — all inside
 one jit whose trace cost is independent of the grid size.
 """
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from bolt_tpu.parallel.sharding import combined_spec
 from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _canon,
-                                _chain_apply, _check_live, _constrain,
-                                _traceable)
+                                _chain_apply, _check_live,
+                                _check_value_shape, _constrain, _traceable)
 from bolt_tpu.utils import iterexpand, prod, tupleize
 
 
@@ -245,12 +243,8 @@ class ChunkedArray:
                     tuple(self._plan), self._barray._aval.dtype))
             except Exception:
                 hint_ob = None
-            if (hint_ob is not None
-                    and tuple(tupleize(value_shape)) != tuple(hint_ob.shape)):
-                raise ValueError(
-                    "value_shape %s does not match the inferred per-block "
-                    "shape %s" % (tuple(tupleize(value_shape)),
-                                  tuple(hint_ob.shape)))
+            _check_value_shape(
+                value_shape, None if hint_ob is None else tuple(hint_ob.shape))
         b = self._barray
         split = b.split
         mesh = b.mesh

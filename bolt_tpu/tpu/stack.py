@@ -13,15 +13,13 @@ thin compatibility view: it exposes the same block-wise ``map`` contract
 blocks in one compiled program.
 """
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 
 from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _canon,
-                                _chain_apply, _check_live, _constrain,
-                                _traceable)
-from bolt_tpu.utils import prod, tupleize
+                                _chain_apply, _check_live,
+                                _check_value_shape, _constrain, _traceable)
+from bolt_tpu.utils import prod
 
 
 class StackedArray:
@@ -81,6 +79,16 @@ class StackedArray:
         size = self._size
         base, funcs = b._chain_parts()
         canon = None if dtype is None else _canon(dtype)
+        if value_shape is not None:
+            # validate BEFORE compiling/executing the full program (the
+            # per-record output shape is the block shape minus the axis)
+            try:
+                ob = jax.eval_shape(func, jax.ShapeDtypeStruct(
+                    (min(size, n) or size,) + vshape, b._aval.dtype))
+            except Exception:
+                ob = None
+            _check_value_shape(
+                value_shape, None if ob is None else tuple(ob.shape[1:]))
 
         def build():
             def run(data):
@@ -127,11 +135,6 @@ class StackedArray:
         fn = _cached_jit(("stack-map", func, funcs, base.shape,
                           str(base.dtype), split, size, canon, mesh), build)
         out = fn(_check_live(base))
-        if value_shape is not None and tuple(out.shape[split:]) != tuple(
-                tupleize(value_shape)):
-            raise ValueError(
-                "value_shape %s does not match the mapped value shape %s"
-                % (tuple(tupleize(value_shape)), tuple(out.shape[split:])))
         return StackedArray(BoltArrayTPU(out, split, mesh), size)
 
     def unstack(self):

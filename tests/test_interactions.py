@@ -190,3 +190,18 @@ def test_chunk_and_stack_maps_fuse_deferred_chains(mesh):
     out3 = b.stacked(size=3).map(lambda blk: blk - 1).unstack()
     assert b.deferred
     assert allclose(out3.toarray(), x)
+
+
+def test_swap_fuses_deferred_chain(mesh):
+    # swap pulls an unmaterialised chain into its transpose program: the
+    # source stays deferred, results match the oracle
+    x = _x()
+    b = bolt.array(x, mesh).map(lambda v: v * 3)
+    assert b.deferred
+    s = b.swap((0,), (1,))
+    assert b.deferred
+    assert allclose(s.toarray(), np.transpose(x * 3, (2, 0, 1)))
+    # donation still materialises first (the base buffer may be aliased)
+    b2 = bolt.array(x, mesh).map(lambda v: v + 1)
+    s2 = b2.swap((0,), (1,), donate=True)
+    assert allclose(s2.toarray(), np.transpose(x + 1, (2, 0, 1)))
