@@ -1,6 +1,6 @@
 from bolt_tpu.ops.kernels import fused_map_reduce, fused_stats
-from bolt_tpu.ops.linalg import (jacobi_eigh, pca, svdvals, tallskinny_pca,
-                                 tallskinny_svd, tsqr)
+from bolt_tpu.ops.linalg import (jacobi_eigh, lstsq, pca, svdvals,
+                                 tallskinny_pca, tallskinny_svd, tsqr)
 
-__all__ = ["fused_map_reduce", "fused_stats", "jacobi_eigh", "pca",
-           "svdvals", "tallskinny_pca", "tallskinny_svd", "tsqr"]
+__all__ = ["fused_map_reduce", "fused_stats", "jacobi_eigh", "lstsq",
+           "pca", "svdvals", "tallskinny_pca", "tallskinny_svd", "tsqr"]

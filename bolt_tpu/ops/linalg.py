@@ -299,6 +299,44 @@ def _widen(x, xp):
     return x
 
 
+def lstsq(a, b):
+    """Least-squares solution of tall-skinny ``a @ x ~ b``, TPU-first.
+
+    ``a`` is ``(..., n, d)`` with ``n >= d`` and full column rank; ``b``
+    is ``(..., n)`` or ``(..., n, k)``.  Returns ``x`` shaped
+    ``(..., d)`` / ``(..., d, k)``.  Solved through :func:`tsqr`
+    (CholeskyQR2): the O(n d^2) work is explicit-precision MXU matmuls,
+    the triangular solve touches only (d, d), and one residual-refinement
+    step scrubs the solve's rounding — no column-serial Householder
+    sweep.  Same conditioning envelope as :func:`tsqr` (cond(a) up to
+    ~1/sqrt(eps)); for rank-deficient or ill-conditioned systems use
+    ``jnp.linalg.lstsq``.
+    """
+    a = _widen(jnp.asarray(a), jnp)
+    b = _widen(jnp.asarray(b), jnp)
+    if jnp.iscomplexobj(a) or jnp.iscomplexobj(b):
+        raise ValueError("lstsq supports real systems; use jnp.linalg.lstsq "
+                         "for complex ones")
+    # promote, never narrow (an f64 b must not silently drop to f32 a)
+    dt = jnp.promote_types(a.dtype, b.dtype)
+    a, b = a.astype(dt), b.astype(dt)
+    vec = b.ndim == a.ndim - 1
+    if a.ndim < 2 or (not vec and b.ndim != a.ndim)             or b.shape[-2 if not vec else -1] != a.shape[-2]:
+        raise ValueError(
+            "lstsq needs a (..., n, d) and b (..., n) or (..., n, k); got "
+            "%s and %s" % (a.shape, b.shape))
+    if vec:
+        b = b[..., None]
+    q, r = tsqr(a)
+    y = jnp.matmul(_adjoint(q), b, precision="highest")
+    x = jax.scipy.linalg.solve_triangular(r, y, lower=False)
+    # one refinement pass: e = y - r x at full precision repairs the
+    # solve's blocked-matmul rounding (see tsqr's r_inv note)
+    e = y - jnp.matmul(r, x, precision="highest")
+    x = x + jax.scipy.linalg.solve_triangular(r, e, lower=False)
+    return x[..., 0] if vec else x
+
+
 def tallskinny_svd(x, k=None):
     """Thin SVD ``(u, s, vh)`` of tall-skinny (batched) matrices via the
     Gram route: one MXU matmul over the ``(..., n, d)`` data, a (d, d)

@@ -69,6 +69,23 @@ def worker(pid):
     full = m.toarray()  # cross-host allgather path
     assert np.allclose(full, x * 2 + 1)
 
+    # the sharded loader: each PROCESS's callback must be invoked only
+    # for its own devices' shards — the full array is never assembled in
+    # any single process
+    src = np.arange(nkeys * 3, dtype=np.float64).reshape(nkeys, 3)
+    calls = []
+
+    def loader(idx):
+        calls.append(idx)
+        return src[idx]
+
+    ld = bolt.fromcallback(loader, src.shape, mesh)
+    n_local = len(jax.local_devices())
+    assert len(calls) == n_local, (len(calls), n_local)
+    rows_seen = sum(len(range(*c[0].indices(nkeys))) for c in calls)
+    assert rows_seen == nkeys // NPROC, (rows_seen, nkeys, NPROC)
+    assert np.array_equal(ld.toarray(), src)
+
     # whole-array PCA: the Gram partial products combine with an
     # all-reduce that rides the (simulated) DCN between the processes
     from bolt_tpu.ops import pca

@@ -272,3 +272,47 @@ def test_jacobi_is_differentiable():
         num = (np.linalg.svd(xp[7], compute_uv=False).sum()
                - np.linalg.svd(xm[7], compute_uv=False).sum()) / (2 * eps)
         assert abs(g3[7, 0, i] - num) < 1e-5
+
+
+def test_lstsq_matches_numpy():
+    from bolt_tpu.ops import lstsq
+    rs = np.random.RandomState(14)
+    a = rs.randn(200, 7)
+    # matrix rhs
+    b = rs.randn(200, 3)
+    x = np.asarray(lstsq(jnp.asarray(a), jnp.asarray(b)))
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    assert np.allclose(x, ref, atol=1e-10)
+    # vector rhs keeps the vector shape
+    bv = rs.randn(200)
+    xv = np.asarray(lstsq(jnp.asarray(a), jnp.asarray(bv)))
+    assert xv.shape == (7,)
+    assert np.allclose(xv, np.linalg.lstsq(a, bv, rcond=None)[0], atol=1e-10)
+    # batched
+    ab = rs.randn(4, 64, 5)
+    bb = rs.randn(4, 64, 2)
+    xb = np.asarray(lstsq(jnp.asarray(ab), jnp.asarray(bb)))
+    refb = np.stack([np.linalg.lstsq(ab[i], bb[i], rcond=None)[0]
+                     for i in range(4)])
+    assert np.allclose(xb, refb, atol=1e-9)
+    # conditioned columns: still accurate well inside the tsqr envelope
+    ac = rs.randn(500, 6) * np.logspace(0, 3, 6)
+    bc = rs.randn(500)
+    xc = np.asarray(lstsq(jnp.asarray(ac), jnp.asarray(bc)))
+    assert np.allclose(xc, np.linalg.lstsq(ac, bc, rcond=None)[0],
+                       rtol=1e-7)
+    with pytest.raises(ValueError):
+        lstsq(jnp.zeros((4, 8)), jnp.zeros(4))     # wide
+    with pytest.raises(ValueError):
+        lstsq(jnp.zeros((8, 4)), jnp.zeros(7))     # row mismatch
+
+
+def test_lstsq_dtype_promotion_and_complex_rejection():
+    from bolt_tpu.ops import lstsq
+    rs = np.random.RandomState(15)
+    a32 = rs.randn(64, 4).astype(np.float32)
+    b64 = rs.randn(64)
+    x = lstsq(jnp.asarray(a32), jnp.asarray(b64))
+    assert np.asarray(x).dtype == np.float64   # promoted, not narrowed
+    with pytest.raises(ValueError):
+        lstsq(jnp.asarray(a32), jnp.asarray(b64 + 1j * b64))
