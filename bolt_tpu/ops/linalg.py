@@ -311,7 +311,27 @@ def lstsq(a, b):
     sweep.  Same conditioning envelope as :func:`tsqr` (cond(a) up to
     ~1/sqrt(eps)); for rank-deficient or ill-conditioned systems use
     ``jnp.linalg.lstsq``.
+
+    ``a`` (and ``b``) may also be bolt arrays: records are the rows (key
+    axes flatten to ``n`` — axis 0 on the local backend), value axes
+    flatten to the ``d`` features / ``k`` targets.  On mode 'tpu' the
+    data stays sharded and GSPMD inserts the all-reduce for the
+    Gram-sized contractions (unlike :func:`pca` this is not one cached
+    program — a deferred chain materialises first).
     """
+    if getattr(a, "mode", None) == "tpu":
+        n = prod(a.shape[:a.split])
+        a = a.tojax().reshape((n, prod(a.shape[a.split:])))
+    elif getattr(a, "mode", None) == "local":
+        a = np.asarray(a).reshape((a.shape[0], -1))
+    if getattr(b, "mode", None) == "tpu":
+        n = prod(b.shape[:b.split])
+        rest = prod(b.shape[b.split:])
+        bj = b.tojax()
+        b = bj.reshape((n,)) if b.ndim == b.split else bj.reshape((n, rest))
+    elif getattr(b, "mode", None) == "local":
+        bl = np.asarray(b)
+        b = bl if bl.ndim == 1 else bl.reshape((bl.shape[0], -1))
     a = _widen(jnp.asarray(a), jnp)
     b = _widen(jnp.asarray(b), jnp)
     if jnp.iscomplexobj(a) or jnp.iscomplexobj(b):
@@ -321,7 +341,8 @@ def lstsq(a, b):
     dt = jnp.promote_types(a.dtype, b.dtype)
     a, b = a.astype(dt), b.astype(dt)
     vec = b.ndim == a.ndim - 1
-    if a.ndim < 2 or (not vec and b.ndim != a.ndim)             or b.shape[-2 if not vec else -1] != a.shape[-2]:
+    if a.ndim < 2 or (not vec and b.ndim != a.ndim) \
+            or b.shape[-2 if not vec else -1] != a.shape[-2]:
         raise ValueError(
             "lstsq needs a (..., n, d) and b (..., n) or (..., n, k); got "
             "%s and %s" % (a.shape, b.shape))
