@@ -317,7 +317,10 @@ def lstsq(a, b):
     flatten to the ``d`` features / ``k`` targets.  On mode 'tpu' the
     data stays sharded and GSPMD inserts the all-reduce for the
     Gram-sized contractions (unlike :func:`pca` this is not one cached
-    program — a deferred chain materialises first).
+    program — a deferred chain materialises first).  Memory: the thin
+    ``q`` is materialised at the size of ``a`` — for HBM-filling systems
+    form the normal equations from Gram blocks instead (the
+    :func:`tallskinny_pca` machinery).
     """
     if getattr(a, "mode", None) == "tpu":
         n = prod(a.shape[:a.split])
