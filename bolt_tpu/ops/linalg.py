@@ -429,7 +429,7 @@ def tsqr(x):
     return q, jnp.matmul(r2, r1, precision="highest")
 
 
-def pca(b, k=None, center=False, axis=None):
+def pca(b, k=None, center=False, axis=None, return_mean=False):
     """Distributed PCA of a bolt array: sample axes x feature axes, all
     in ONE compiled SPMD program.
 
@@ -456,7 +456,10 @@ def pca(b, k=None, center=False, axis=None):
     Returns ``(scores, components, singular_values)``: scores is a bolt
     array shaped ``sample_shape + (k,)`` with the input's mode (and key
     sharding on TPU); components ``(d, k)`` and singular values ``(k,)``
-    are NumPy arrays (descending).
+    are NumPy arrays (descending).  With ``return_mean=True`` a fourth
+    element is the per-feature mean ``(d,)`` that was subtracted (zeros
+    when ``center=False``) — needed to project NEW data consistently:
+    ``scores_new = (x_new - mean) @ components``.
     """
     from bolt_tpu.utils import tupleize
 
@@ -488,12 +491,15 @@ def pca(b, k=None, center=False, axis=None):
     if mode == "local":
         # the NumPy oracle: same sequence, host-side
         x = _widen(x_full.reshape(n, d), np)
+        mu = x.mean(axis=0) if center else np.zeros(d, x.dtype)
         if center:
-            x = x - x.mean(axis=0, keepdims=True)
+            x = x - mu
         vec, ev = _gram_decompose(x, k, np, np.linalg.eigh)
         vec = np.ascontiguousarray(vec)
         scores = (x @ vec).reshape(kshape + (k,))
-        return (type(b)(scores), vec, np.sqrt(ev).astype(_real_dtype(x.dtype)))
+        out = (type(b)(scores), vec,
+               np.sqrt(ev).astype(_real_dtype(x.dtype)))
+        return out + (mu,) if return_mean else out
 
     from bolt_tpu.parallel.sharding import key_sharding
     from bolt_tpu.tpu.array import _cached_jit, _chain_apply
@@ -506,8 +512,9 @@ def pca(b, k=None, center=False, axis=None):
         def program(data):
             mapped = _chain_apply(funcs, split, data)
             x = _widen(mapped.reshape((n, d)), jnp)
+            mu = jnp.mean(x, axis=0) if center else jnp.zeros(d, x.dtype)
             if center:
-                x = x - jnp.mean(x, axis=0, keepdims=True)
+                x = x - mu
             vec, ev = _gram_decompose(x, k, jnp, _tpu_eigh)
             # precision="highest": the MXU's bf16 default costs ~3 decimal
             # digits on f32 data — visible in scores at PCA scale
@@ -515,15 +522,15 @@ def pca(b, k=None, center=False, axis=None):
                 kshape + (k,))
             scores = jax.lax.with_sharding_constraint(
                 scores, key_sharding(mesh, kshape + (k,), split))
-            return scores, vec, jnp.sqrt(ev)
+            return scores, vec, jnp.sqrt(ev), mu
         return jax.jit(program)
 
     fn = _cached_jit(("ops-pca", funcs, base.shape, str(base.dtype), split,
                       mesh, k, center), build)
-    scores, vec, sv = fn(base)
-    out = type(b)(scores, split, mesh)
-    return (out, np.asarray(jax.device_get(vec)),
-            np.asarray(jax.device_get(sv)))
+    scores, vec, sv, mu = fn(base)
+    out = (type(b)(scores, split, mesh), np.asarray(jax.device_get(vec)),
+           np.asarray(jax.device_get(sv)))
+    return out + (np.asarray(jax.device_get(mu)),) if return_mean else out
 
 
 def tallskinny_pca(x, k=None):

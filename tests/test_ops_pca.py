@@ -210,3 +210,25 @@ def test_lstsq_local_bolt_arrays_match_tpu(mesh):
                           bolt.array(y)))
     assert xt.shape == xl.shape == (5,)
     assert np.allclose(xt, xl, atol=1e-9)
+
+
+def test_pca_return_mean_projects_new_data(mesh):
+    # the subtracted mean comes back so NEW samples project consistently
+    from bolt_tpu.ops import pca
+    rs = np.random.RandomState(18)
+    x = rs.randn(48, 6) + 3.0
+    b = bolt.array(x, mesh, axis=(0,))
+    scores, comps, svals, mu = pca(b, k=2, center=True, return_mean=True)
+    assert np.allclose(mu, x.mean(axis=0), atol=1e-9)
+    xnew = rs.randn(5, 6) + 3.0
+    proj = (xnew - mu) @ comps
+    # projecting the TRAINING data reproduces its scores
+    retr = (x - mu) @ comps
+    assert np.allclose(retr, np.asarray(scores.toarray()), atol=1e-8)
+    assert proj.shape == (5, 2)
+    # uncentered: mean returned as zeros
+    _, _, _, mu0 = pca(b, k=2, return_mean=True)
+    assert np.allclose(mu0, 0.0)
+    # local backend agrees
+    _, _, _, mul = pca(bolt.array(x), k=2, center=True, return_mean=True)
+    assert np.allclose(mul, mu, atol=1e-9)
