@@ -106,6 +106,18 @@ def main():
     assert scores.mode == "tpu" and scores.shape == (npts, 4)
 
     # ------------------------------------------------------------------
+    section("5c. distributed least squares (per-pixel trend fit)")
+    # fit a linear trend to every pixel's time series in ONE call: the
+    # sharded design matrix stays sharded, GSPMD inserts the all-reduce
+    from bolt_tpu.ops import lstsq
+    t = np.arange(512, dtype=np.float64)
+    design = np.stack([np.ones_like(t), t], axis=1)        # (512, 2)
+    targets = bolt.array(stack.reshape(512, -1), mesh, axis=(0,))
+    coef = np.asarray(lstsq(design, targets.tojax()))
+    ref = np.linalg.lstsq(design, stack.reshape(512, -1), rcond=None)[0]
+    assert np.allclose(coef, ref, atol=1e-6)
+
+    # ------------------------------------------------------------------
     section("6. select + mask: keyed filtering")
     means = stack.mean(axis=(1, 2))
     bright = b.filter(lambda im: im.mean() > 0)
