@@ -113,7 +113,7 @@ def main():
     t = np.arange(512, dtype=np.float64)
     design = np.stack([np.ones_like(t), t], axis=1)        # (512, 2)
     targets = bolt.array(stack.reshape(512, -1), mesh, axis=(0,))
-    coef = np.asarray(lstsq(design, targets.tojax()))
+    coef = np.asarray(lstsq(design, targets))   # bolt array direct
     ref = np.linalg.lstsq(design, stack.reshape(512, -1), rcond=None)[0]
     assert np.allclose(coef, ref, atol=1e-6)
 

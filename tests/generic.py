@@ -53,3 +53,12 @@ def reduce_suite(x, b):
     # keepdims
     kd = b.reduce(add, axis=(0,), keepdims=True).toarray()
     assert allclose(kd, x.sum(axis=0, keepdims=True))
+
+
+# hypothesis knobs shared by the property/fuzz suites:
+# BOLT_HYPOTHESIS_EXAMPLES=200 for a deep run; 25 keeps CI fast
+import os
+
+HYPOTHESIS_SETTINGS = dict(
+    max_examples=int(os.environ.get("BOLT_HYPOTHESIS_EXAMPLES", "25")),
+    deadline=None)

@@ -1,0 +1,112 @@
+"""Compositional pipeline fuzzing: random SEQUENCES of operations on the
+TPU backend vs a NumPy mirror.
+
+The single-op property suite (test_property.py) fuzzes each operation in
+isolation; real workloads chain them, and the deferred/pending/sharding
+state machine has interactions no single-op test reaches (a swap of a
+deferred chain of a filter result, a getitem after an astype after a
+chunked map, ...).  Each case draws 2-5 ops from the pool below, applies
+them to both representations, and asserts `allclose` parity at the end."""
+
+import os
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose
+
+from tests.generic import HYPOTHESIS_SETTINGS as SETTINGS
+
+
+def _op_map_affine(draw, b, x):
+    a = draw(st.sampled_from([-2.0, 0.5, 3.0]))
+    c = draw(st.sampled_from([-1.0, 0.0, 2.5]))
+    return b.map(lambda v, _a=a, _c=c: v * _a + _c), x * a + c
+
+
+def _op_operator(draw, b, x):
+    c = draw(st.sampled_from([1.5, -0.5]))
+    return b + c, x + c
+
+
+def _op_slice0(draw, b, x):
+    n = x.shape[0]
+    if n < 2:
+        return b, x
+    lo = draw(st.integers(0, n - 2))
+    hi = draw(st.integers(lo + 1, n))
+    return b[lo:hi], x[lo:hi]
+
+
+def _op_swap(draw, b, x):
+    if b.split < 1 or b.ndim - b.split < 1:
+        return b, x
+    s = b.split
+    perm = ([k for k in range(s) if k != 0] + [s]
+            + [0] + list(range(s + 1, b.ndim)))
+    return b.swap((0,), (0,)), np.transpose(x, perm)
+
+
+def _op_vtranspose(draw, b, x):
+    nv = b.ndim - b.split
+    if nv < 2:
+        return b, x
+    axes = tuple(reversed(range(nv)))
+    return (b.values.transpose(*axes),
+            np.transpose(x, tuple(range(b.split))
+                         + tuple(b.split + a for a in axes)))
+
+
+def _op_astype(draw, b, x):
+    dt = draw(st.sampled_from([np.float32, np.float64]))
+    return b.astype(dt), x.astype(dt)
+
+
+def _op_filter(draw, b, x):
+    if b.split != 1 or x.shape[0] < 2:
+        return b, x
+    thresh = draw(st.sampled_from([-0.5, 0.0, 0.5]))
+    keep = x.reshape(x.shape[0], -1).mean(axis=1) > thresh
+    return (b.filter(lambda v, _t=thresh: v.mean() > _t), x[keep])
+
+
+def _op_chunked_map(draw, b, x):
+    nv = b.ndim - b.split
+    if nv < 1 or x.shape[b.split] < 2:
+        return b, x
+    c = draw(st.integers(1, x.shape[b.split]))
+    out = b.chunk(size=(c,), axis=(0,)).map(
+        lambda blk: blk * 2.0).unchunk()
+    return out, x * 2.0
+
+
+_OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
+        _op_astype, _op_filter, _op_chunked_map]
+
+
+@given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
+@settings(**SETTINGS)
+def test_random_pipelines_match_numpy(mesh, data, seed, depth):
+    rs = np.random.RandomState(seed)
+    shape = tuple(rs.randint(2, 6, size=rs.randint(2, 4)))
+    x = rs.randn(*shape)
+    b = bolt.array(x, mesh, axis=(0,))
+    applied = []
+    for _ in range(depth):
+        op = data.draw(st.sampled_from(_OPS))
+        b, x = op(data.draw, b, x)
+        applied.append(op.__name__)
+        if x.shape[0] == 0:
+            break                        # filtered everything away
+    assert b.shape == x.shape, (applied, b.shape, x.shape)
+    assert allclose(b.toarray(), x), applied
+    # and a terminal reduction agrees when records remain (dtype-aware
+    # tolerance: f32 sums are ulp-close, not bit-exact, across different
+    # summation orders — docs/DESIGN.md numerical-parity policy)
+    if x.shape[0] > 0 and b.split >= 1:
+        got = np.asarray(b.sum(axis=(0,)).toarray())
+        loose = x.dtype == np.float32
+        assert np.allclose(got, x.sum(axis=0),
+                           rtol=1e-5 if loose else 1e-6,
+                           atol=1e-5 if loose else 1e-8), applied

@@ -8,10 +8,7 @@ from hypothesis import given, settings, strategies as st
 import bolt_tpu as bolt
 from bolt_tpu.utils import allclose
 
-# BOLT_HYPOTHESIS_EXAMPLES=200 for a deep fuzz run; 25 keeps CI fast
-import os
-SETTINGS = dict(max_examples=int(os.environ.get("BOLT_HYPOTHESIS_EXAMPLES", "25")),
-                deadline=None)
+from tests.generic import HYPOTHESIS_SETTINGS as SETTINGS
 
 
 @st.composite
