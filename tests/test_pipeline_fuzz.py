@@ -81,8 +81,35 @@ def _op_chunked_map(draw, b, x):
     return out, x * 2.0
 
 
+def _op_stacked_map(draw, b, x):
+    if b.split < 1 or x.shape[0] < 1:
+        return b, x
+    size = draw(st.integers(1, max(1, x.shape[0])))
+    return (b.stacked(size=size).map(lambda blk: blk - 1.0).unstack(),
+            x - 1.0)
+
+
+def _op_concat_self(draw, b, x):
+    if b.split < 1 or x.shape[0] < 1 or x.shape[0] > 8:
+        return b, x
+    return b.concatenate(b, axis=0), np.concatenate([x, x], axis=0)
+
+
+def _op_keys_reshape(draw, b, x):
+    if b.split != 1:
+        return b, x
+    n = x.shape[0]
+    divs = [d for d in range(2, n) if n % d == 0]
+    if not divs:
+        return b, x
+    d = draw(st.sampled_from(divs))
+    return (b.keys.reshape(d, n // d),
+            x.reshape((d, n // d) + x.shape[1:]))
+
+
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
-        _op_astype, _op_filter, _op_chunked_map]
+        _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
+        _op_concat_self, _op_keys_reshape]
 
 
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
