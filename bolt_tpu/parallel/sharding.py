@@ -10,8 +10,16 @@ the reference's shuffle (``swap``/``chunk``) to XLA ``all_to_all`` collective
 code over ICI.
 """
 
+import itertools
+
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bolt_tpu.utils import prod
+
+# exhaustive-assignment search bound: (split+1)**n_mesh_axes combinations
+# (real meshes have <=4 axes, so the search is effectively always on)
+_SEARCH_LIMIT = 4096
 
 
 def spec_names(entry):
@@ -34,6 +42,14 @@ def key_spec(mesh, shape, split, reserved=()):
     devices idle.  Unassigned axes (all value axes, and key axes nothing
     divides) are replicated — the exact analog of the reference's
     "records spread over partitions, block local to a worker".
+
+    When the greedy order leaves devices idle that SOME assignment could
+    use (e.g. keys ``(4, 2)`` on a mesh ``a=2, b=4``: greedy takes ``a``
+    for the first key axis and strands ``b``), an exhaustive
+    divisibility-matching search over all mesh-axis → key-axis
+    assignments finds the utilization-optimal one.  The greedy result is
+    kept whenever it is already optimal, so specs (and the sharding
+    caches keyed on them) are stable for the common cases.
     """
     spec = [None] * len(shape)
     if mesh is not None:
@@ -62,12 +78,47 @@ def key_spec(mesh, shape, split, reserved=()):
                     width[i] *= mesh.shape[name]
                     used.add(name)
                     break
+        candidates = [n for n in mesh.axis_names
+                      if n not in reserved and mesh.shape[n] > 1]
+        greedy_width = prod(width)
+        full_width = prod([mesh.shape[n] for n in candidates])
+        if greedy_width < full_width:
+            best = _match_axes(mesh, shape, split, candidates, greedy_width)
+            if best is not None:
+                assigned = best
         for i in range(split):
             if len(assigned[i]) == 1:
                 spec[i] = assigned[i][0]
             elif assigned[i]:
                 spec[i] = tuple(assigned[i])
     return P(*spec)
+
+
+def _match_axes(mesh, shape, split, candidates, floor):
+    """Exhaustive mesh-axis → key-axis matching; returns per-key-axis name
+    lists strictly beating ``floor`` devices utilized, else ``None``.
+
+    Enumerates every assignment of each candidate mesh axis to one key
+    axis (or none), keeps those where each key axis's combined width
+    divides its size, and picks the one using the most devices.  Ties go
+    to the first in enumeration order — mesh axes in name order preferring
+    earlier key axes — so the result is deterministic."""
+    if (split + 1) ** len(candidates) > _SEARCH_LIMIT:
+        return None
+    best, best_width = None, floor
+    for choice in itertools.product(range(split + 1), repeat=len(candidates)):
+        widths = [1] * split
+        for name, ki in zip(candidates, choice):
+            if ki < split:
+                widths[ki] *= mesh.shape[name]
+        if any(shape[i] % widths[i] != 0 for i in range(split)):
+            continue
+        total = prod(widths)
+        if total > best_width:
+            best_width = total
+            best = [[n for n, ki in zip(candidates, choice) if ki == i]
+                    for i in range(split)]
+    return best
 
 
 def combined_spec(mesh, shape, split, value_axes=None):

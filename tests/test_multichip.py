@@ -32,6 +32,32 @@ def test_key_spec_assignment(mesh, mesh2d):
     assert tuple(key_spec(mesh2d, (4, 4, 6), 2)) == ("a", "b", None)
 
 
+def test_key_spec_matching_beats_greedy_order():
+    # mesh axes ordered (2, 4): greedy gives key axis 0 (size 4) the size-2
+    # mesh axis 'a' and strands 'b' (4 % (2*4) != 0, and key axis 1 can't
+    # take a second chance on 'a').  The matching search finds the full
+    # assignment: key 0 -> b(4), key 1 -> a(2) — all 8 devices busy.
+    m = jax.make_mesh((2, 4), ("a", "b"))
+    assert tuple(key_spec(m, (4, 2, 6), 2)) == ("b", "a", None)
+    # single key axis: 'b' alone (4-way) beats greedy's 'a' (2-way);
+    # absorption can't rescue greedy because 4 % (2*4) != 0
+    assert tuple(key_spec(m, (4, 6), 1)) == ("b", None)
+    # greedy already optimal -> spec unchanged by the search
+    assert tuple(key_spec(m, (2, 4, 6), 2)) == ("a", "b", None)
+    # nothing divides -> still replicated
+    assert tuple(key_spec(m, (7, 5), 2)) == (None, None)
+
+
+def test_matching_assignment_end_to_end():
+    m = jax.make_mesh((2, 4), ("a", "b"))
+    x = _x((4, 2, 6))
+    b = bolt.array(x, m, axis=(0, 1))
+    assert len(b._data.addressable_shards) == 8
+    assert all(s.data.shape == (1, 1, 6) for s in b._data.addressable_shards)
+    assert allclose(b.map(lambda v: v + 1).sum(axis=(0, 1)).toarray(),
+                    (x + 1).sum(axis=(0, 1)))
+
+
 def test_single_key_axis_uses_whole_2d_mesh(mesh2d):
     # end to end: one key axis on the (4, 2) mesh spreads over all 8
     # devices, and collectives still produce oracle answers
