@@ -131,6 +131,37 @@ class BoltArrayLocal(np.ndarray, BoltArray):
             stats=requested)
 
     # ------------------------------------------------------------------
+    # chunked / stacked views (superset of the reference, which has them
+    # only on the distributed backend — ``bolt/spark/chunk.py`` /
+    # ``bolt/spark/stack.py``; here the same contract runs on NumPy so
+    # mode-agnostic user code needs no SparkContext/mesh)
+    # ------------------------------------------------------------------
+
+    def chunk(self, size="150", axis=None, padding=None, key_axis=(0,)):
+        """Decompose the value axes into chunks; returns a
+        :class:`~bolt_tpu.local.chunk.LocalChunkedArray`.
+
+        ``key_axis`` names this array's key axes (the distributed backend
+        carries its split intrinsically; this backend, like its ``map``,
+        takes the key-axis set per call) — they are moved to the front, and
+        ``axis``/``size``/``padding`` address the remaining value axes
+        exactly as on the TPU backend."""
+        from bolt_tpu.local.chunk import LocalChunkedArray
+        flat, key_shape, value_shape = self._kv_reshape(key_axis)
+        data = flat.reshape(key_shape + value_shape)
+        return LocalChunkedArray.chunk(data, len(key_shape), size=size,
+                                       axis=axis, padding=padding)
+
+    def stacked(self, size=1000, key_axis=(0,)):
+        """Batch flat key records into blocks; returns a
+        :class:`~bolt_tpu.local.stack.LocalStackedArray` (same contract as
+        the TPU backend's compatibility view)."""
+        from bolt_tpu.local.stack import LocalStackedArray
+        flat, key_shape, value_shape = self._kv_reshape(key_axis)
+        data = flat.reshape(key_shape + value_shape)
+        return LocalStackedArray(data, len(key_shape), size)
+
+    # ------------------------------------------------------------------
     # conversions
     # ------------------------------------------------------------------
 

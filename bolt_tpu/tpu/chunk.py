@@ -28,7 +28,8 @@ from bolt_tpu.parallel.sharding import combined_spec
 from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _canon,
                                 _chain_apply, _check_live,
                                 _check_value_shape, _constrain, _traceable)
-from bolt_tpu.utils import iterexpand, prod, tupleize
+from bolt_tpu.utils import (chunk_axes, chunk_pad, chunk_plan, iterexpand,
+                            tupleize)
 
 
 def _constrain_chunked(out, mesh, split, vshard):
@@ -103,40 +104,9 @@ class ChunkedArray:
         """
         split = barray.split
         vshape = barray.shape[split:]
-        nv = len(vshape)
-        if axis is None:
-            axes = tuple(range(nv))
-        else:
-            axes = tuple(sorted(tupleize(axis)))
-            for a in axes:
-                if a < 0 or a >= nv:
-                    raise ValueError(
-                        "chunk axis %d out of range for %d value axes" % (a, nv))
-
-        plan = list(vshape)
-        if isinstance(size, str):
-            budget = float(size) * 1e6
-            itemsize = barray.dtype.itemsize
-            while (prod(plan) * itemsize > budget
-                   and any(plan[a] > 1 for a in axes)):
-                a = max(axes, key=lambda i: plan[i])
-                plan[a] = -(-plan[a] // 2)
-        else:
-            sizes = iterexpand(size, len(axes))
-            for a, s in zip(axes, sizes):
-                if s < 1:
-                    raise ValueError("chunk size must be >= 1, got %d" % s)
-                plan[a] = min(int(s), vshape[a])
-
-        pad = [0] * nv
-        if padding is not None:
-            pads = iterexpand(padding, len(axes))
-            for a, p in zip(axes, pads):
-                if p < 0 or (p > 0 and p >= plan[a]):
-                    raise ValueError(
-                        "padding %d must be smaller than the chunk size %d "
-                        "on axis %d" % (p, plan[a], a))
-                pad[a] = int(p)
+        axes = chunk_axes(vshape, axis)
+        plan = chunk_plan(vshape, barray.dtype.itemsize, size, axes)
+        pad = chunk_pad(plan, axes, padding, len(vshape))
         return cls(barray, plan, pad)
 
     # ------------------------------------------------------------------

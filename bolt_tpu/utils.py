@@ -172,3 +172,56 @@ def get_kv_shape(shape, axes):
     """
     kaxes, vaxes = get_kv_axes(shape, axes)
     return (tuple(shape[a] for a in kaxes), tuple(shape[a] for a in vaxes))
+
+
+def chunk_axes(vshape, axis):
+    """Normalize a chunk ``axis`` request against a value shape: ``None``
+    means every value axis; out-of-range axes raise (shared by both
+    backends' ``chunk``; reference: the axis handling of
+    ``bolt/spark/chunk.py :: ChunkedArray._chunk``)."""
+    nv = len(vshape)
+    if axis is None:
+        return tuple(range(nv))
+    axes = tuple(sorted(tupleize(axis)))
+    for a in axes:
+        if a < 0 or a >= nv:
+            raise ValueError(
+                "chunk axis %d out of range for %d value axes" % (a, nv))
+    return axes
+
+
+def chunk_plan(vshape, itemsize, size, axes):
+    """Per-value-axis chunk sizes.  A string ``size`` is a per-block
+    megabyte budget (the reference's ``size='150'`` default) — the largest
+    chunkable axis is halved until the block fits; an int/tuple gives
+    explicit chunk sizes for ``axes`` (reference:
+    ``bolt/spark/chunk.py :: ChunkedArray._chunk`` plan computation)."""
+    plan = list(vshape)
+    if isinstance(size, str):
+        budget = float(size) * 1e6
+        while (prod(plan) * itemsize > budget
+               and any(plan[a] > 1 for a in axes)):
+            a = max(axes, key=lambda i: plan[i])
+            plan[a] = -(-plan[a] // 2)
+    else:
+        sizes = iterexpand(size, len(axes))
+        for a, s in zip(axes, sizes):
+            if s < 1:
+                raise ValueError("chunk size must be >= 1, got %d" % s)
+            plan[a] = min(int(s), vshape[a])
+    return plan
+
+
+def chunk_pad(plan, axes, padding, nv):
+    """Per-value-axis halo widths; a halo must be smaller than its chunk
+    (reference: ``ChunkedArray._chunk`` padding validation)."""
+    pad = [0] * nv
+    if padding is not None:
+        pads = iterexpand(padding, len(axes))
+        for a, p in zip(axes, pads):
+            if p < 0 or (p > 0 and p >= plan[a]):
+                raise ValueError(
+                    "padding %d must be smaller than the chunk size %d "
+                    "on axis %d" % (p, plan[a], a))
+            pad[a] = int(p)
+    return pad

@@ -1,0 +1,180 @@
+"""Local-backend chunked/stacked views: parity against the TPU backend
+(and manual NumPy), so mode-agnostic chunked code has a local oracle.
+Superset of the reference, which has ChunkedArray/StackedArray only on the
+distributed backend (SURVEY §2.1)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.utils import allclose, prod
+
+
+def _x(shape=(4, 6, 8)):
+    rs = np.random.RandomState(3)
+    return rs.randn(*shape)
+
+
+def _pair(mesh, x, **kw):
+    """The same chunk view on both backends."""
+    lc = bolt.array(x).chunk(**kw)
+    tc = bolt.array(x, mesh).chunk(**kw)
+    return lc, tc
+
+
+def test_plan_parity(mesh):
+    x = _x()
+    lc, tc = _pair(mesh, x, size=(2, 3), axis=(0, 1))
+    assert lc.plan == tc.plan == (2, 3)
+    assert lc.padding == tc.padding == (0, 0)
+    assert lc.grid == tc.grid == (3, 3)
+    assert lc.kshape == (4,) and lc.vshape == (6, 8)
+    assert lc.uniform == tc.uniform
+    assert lc.mode == "local" and tc.mode == "tpu"
+    # MB-budget plans agree (same shared helper)
+    lb, tb = _pair(mesh, x, size=str(64 / 1e6))
+    assert lb.plan == tb.plan
+
+
+def test_unchunk_roundtrip():
+    x = _x()
+    c = bolt.array(x).chunk(size=(2,), axis=(0,))
+    out = c.unchunk()
+    assert out.mode == "local"
+    assert allclose(out.toarray(), x)
+
+
+def test_map_uniform_shape_change(mesh):
+    x = _x()
+    f = lambda blk: blk.sum(axis=1, keepdims=True)
+    lc, tc = _pair(mesh, x, size=(3,), axis=(0,))
+    lout = lc.map(f).unchunk().toarray()
+    tout = tc.map(f).unchunk().toarray()
+    assert allclose(lout, tout)
+    # manual: per (key, chunk) block sum over the second value axis
+    expect = np.stack([
+        np.concatenate([x[k, i * 3:(i + 1) * 3].sum(axis=1, keepdims=True)
+                        for i in range(2)], axis=0) for k in range(4)])
+    assert allclose(lout, expect)
+
+
+def test_map_padded_halo(mesh):
+    x = _x()
+    # halo-smoothing: with padding=1 each block sees its neighbours'
+    # boundary rows, so a local mean filter matches the global one
+    def smooth(blk):
+        out = np.copy(blk)
+        out[1:-1] = (blk[:-2] + blk[1:-1] + blk[2:]) / 3.0
+        return out
+    lc, tc = _pair(mesh, x, size=(2,), axis=(0,), padding=1)
+    lout = lc.map(smooth).unchunk().toarray()
+    import jax.numpy as jnp
+    def smooth_j(blk):
+        return jnp.concatenate(
+            [blk[:1], (blk[:-2] + blk[1:-1] + blk[2:]) / 3.0, blk[-1:]],
+            axis=0)
+    tout = tc.map(smooth_j).unchunk().toarray()
+    assert allclose(lout, tout)
+    # interior rows (away from the ARRAY edge) match the global filter
+    glob = (x[:, :-2] + x[:, 1:-1] + x[:, 2:]) / 3.0
+    assert allclose(lout[:, 1:-1], glob)
+
+
+def test_map_ragged_tail(mesh):
+    x = _x((3, 7, 4))
+    f = lambda blk: blk * 2.0
+    lc, tc = _pair(mesh, x, size=(3,), axis=(0,))
+    assert not lc.uniform
+    lout = lc.map(f).unchunk().toarray()
+    tout = tc.map(f).unchunk().toarray()
+    assert allclose(lout, tout)
+    assert allclose(lout, x * 2.0)
+
+
+def test_map_contract_errors():
+    x = _x()
+    c = bolt.array(x).chunk(size=(2,), axis=(0,), padding=1)
+    with pytest.raises(ValueError):
+        c.map(lambda blk: blk[:1])       # padded: must preserve shape
+    cu = bolt.array(x).chunk(size=(3,), axis=(0,))
+    with pytest.raises(ValueError):
+        cu.map(lambda blk: blk.sum())    # uniform: must preserve rank
+    with pytest.raises(ValueError):
+        cu.map(lambda blk: blk, value_shape=(9, 9))
+
+
+def test_axis_exchange_parity(mesh):
+    x = _x()
+    lc, tc = _pair(mesh, x, size=(2,), axis=(0,))
+    l2 = lc.keys_to_values((0,))
+    t2 = tc.keys_to_values((0,))
+    assert l2.split == t2.split == 0
+    assert l2.plan == t2.plan
+    assert allclose(l2.unchunk().toarray(), t2.unchunk().toarray())
+    l3 = l2.values_to_keys((1,))
+    t3 = t2.values_to_keys((1,))
+    assert l3.split == t3.split == 1
+    assert l3.plan == t3.plan
+    assert allclose(l3.unchunk().toarray(), t3.unchunk().toarray())
+    with pytest.raises(ValueError):
+        lc.keys_to_values((5,))
+    with pytest.raises(ValueError):
+        lc.values_to_keys((7,))
+
+
+def test_chunk_key_axis():
+    x = _x()
+    # key axis 1: keys move to the front, value axes are the rest
+    c = bolt.array(x).chunk(size=(2,), axis=(0,), key_axis=(1,))
+    assert c.kshape == (6,) and c.vshape == (4, 8)
+    assert allclose(c.unchunk().toarray(), np.transpose(x, (1, 0, 2)))
+
+
+def test_zero_records():
+    x = np.zeros((0, 6, 8))
+    out = bolt.array(x).chunk(size=(2,), axis=(0,)).map(
+        lambda blk: blk.sum(axis=1, keepdims=True)).unchunk().toarray()
+    assert out.shape == (0, 6, 1)
+
+
+def test_stacked_parity(mesh):
+    x = _x((8, 5, 4))
+    f = lambda blk: blk - blk.mean(axis=0)
+    ls = bolt.array(x).stacked(size=3)
+    ts = bolt.array(x, mesh).stacked(size=3)
+    assert ls.size == ts.size == 3
+    assert ls.nblocks == ts.nblocks == 3
+    lout = ls.map(f).unstack().toarray()
+    tout = ts.map(f).unstack().toarray()
+    assert allclose(lout, tout)
+    # manual oracle: blocks of 3 consecutive records
+    expect = np.concatenate(
+        [x[i:i + 3] - x[i:i + 3].mean(axis=0) for i in (0, 3, 6)])
+    assert allclose(lout, expect)
+
+
+def test_stacked_contract():
+    x = _x((6, 4))
+    s = bolt.array(x).stacked(size=4)
+    with pytest.raises(ValueError):
+        s.map(lambda blk: blk[:1])       # must preserve record count
+    with pytest.raises(ValueError):
+        bolt.array(x).stacked(size=0)
+    out = s.map(lambda blk: blk * 2, value_shape=(4,), dtype=np.float32)
+    assert out.dtype == np.float32
+    assert allclose(out.unstack().toarray(), (x * 2).astype(np.float32))
+
+
+def test_stacked_zero_records():
+    x = np.zeros((0, 4))
+    out = bolt.array(x).stacked(size=8).map(
+        lambda blk: blk * 2.0).unstack().toarray()
+    assert out.shape == (0, 4)
+
+
+def test_repr():
+    c = bolt.array(_x()).chunk(size=(2,), axis=(0,))
+    r = repr(c)
+    assert "mode: local" in r and "plan" in r
+    s = bolt.array(_x()).stacked(size=2)
+    assert "mode: local" in repr(s)
