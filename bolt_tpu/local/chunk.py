@@ -17,16 +17,8 @@ from itertools import product as _product
 
 import numpy as np
 
-from bolt_tpu.utils import (chunk_axes, chunk_pad, chunk_plan, iterexpand,
-                            prod, tupleize)
-
-
-def _check_value_shape(hint, inferred):
-    if hint is None or inferred is None:
-        return
-    if tuple(tupleize(hint)) != tuple(inferred):
-        raise ValueError("value_shape %s does not match inferred %s"
-                         % (tuple(tupleize(hint)), tuple(inferred)))
+from bolt_tpu.utils import (check_value_shape, chunk_align, chunk_pad,
+                            chunk_plan, iterexpand, prod, tupleize)
 
 
 class LocalChunkedArray:
@@ -44,7 +36,7 @@ class LocalChunkedArray:
     def chunk(cls, data, split, size="150", axis=None, padding=None):
         data = np.asarray(data)
         vshape = data.shape[split:]
-        axes = chunk_axes(vshape, axis)
+        axes, size, padding = chunk_align(vshape, axis, size, padding)
         plan = chunk_plan(vshape, data.dtype.itemsize, size, axes)
         pad = chunk_pad(plan, axes, padding, len(vshape))
         return cls(data, split, plan, pad)
@@ -155,7 +147,7 @@ class LocalChunkedArray:
             # func for real)
             probe = one_record(np.zeros(vshape, self._data.dtype))
             out = np.zeros((0,) + probe.shape, probe.dtype)
-        _check_value_shape(value_shape, tuple(
+        check_value_shape(value_shape, tuple(
             o // g for o, g in zip(out.shape[1:], grid)) if shape_change_ok
             else tuple(plan))
         if dtype is not None:
@@ -189,6 +181,10 @@ class LocalChunkedArray:
         moved = [self._data.shape[a] for a in axes]
         if size is not None:
             sizes = iterexpand(size, len(moved))
+            for s in sizes:
+                if int(s) < 1:
+                    raise ValueError(
+                        "chunk size must be >= 1, got %d" % int(s))
             moved = [min(int(s), m) for s, m in zip(sizes, moved)]
         return LocalChunkedArray(
             data, len(keys_rest), tuple(moved) + self._plan,

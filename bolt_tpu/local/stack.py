@@ -9,8 +9,7 @@ chunking: the same block-wise ``map`` contract (``func`` sees
 
 import numpy as np
 
-from bolt_tpu.local.chunk import _check_value_shape
-from bolt_tpu.utils import prod
+from bolt_tpu.utils import check_value_shape, prod
 
 
 class LocalStackedArray:
@@ -74,7 +73,7 @@ class LocalStackedArray:
             probe = np.asarray(func(np.zeros((self._size,) + vshape,
                                              self._data.dtype)))
             out = np.zeros((0,) + probe.shape[1:], probe.dtype)
-        _check_value_shape(value_shape, tuple(out.shape[1:]))
+        check_value_shape(value_shape, tuple(out.shape[1:]))
         if dtype is not None:
             out = out.astype(dtype)
         return LocalStackedArray(out.reshape(kshape + out.shape[1:]),

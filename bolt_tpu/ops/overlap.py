@@ -1,0 +1,97 @@
+"""Halo-overlap mapping and separable smoothing over the value axes.
+
+The reference's chunk ``padding`` exists for exactly this workload: its
+ecosystem (Thunder) ran spatial filters over image stacks by chunking the
+spatial axes with a halo so each block sees its neighbours' boundary rows
+(``bolt/spark/chunk.py :: ChunkedArray`` padding — symbol-level citation,
+SURVEY.md §0).  :func:`map_overlap` packages that pattern (dask names the
+same idiom ``map_overlap``); :func:`smooth` builds the canonical consumer —
+a separable boxcar filter — on top of it.
+
+Both work on either backend: on TPU the chunked map is one compiled SPMD
+program and halos ride GSPMD's neighbour collectives; locally the same
+contract runs on NumPy (the oracle).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from bolt_tpu.utils import chunk_axes, iterexpand, tupleize
+
+_PAD_MODES = ("constant", "reflect", "edge")
+
+
+def map_overlap(b, func, depth, axis=None, size="150", value_shape=None,
+                dtype=None):
+    """Apply ``func`` to halo-padded blocks of the value axes and
+    reassemble: ``b.chunk(size, axis, padding=depth).map(func).unchunk()``.
+
+    ``depth`` is the halo width (scalar, or per-axis paired with ``axis``
+    in the order given); ``func`` must
+    preserve the block shape (the padded-map contract — the halo is
+    trimmed after).  Each block sees ``depth`` extra elements from its
+    neighbours on the chunked axes, clipped at the array edges, so
+    stencil/filter funcs compute correct values at interior block
+    boundaries without any global pass.
+    """
+    c = b.chunk(size=size, axis=axis, padding=depth)
+    return c.map(func, value_shape=value_shape, dtype=dtype).unchunk()
+
+
+def _box1d(x, ax, w, mode, xp):
+    """Windowed mean of width ``w`` along ``ax`` ('same' size, boundary per
+    ``mode``) — the sum of ``w`` shifted slices of the padded array, which
+    is exact (no cumsum cancellation) for the small widths filters use."""
+    h = w // 2
+    length = x.shape[ax]
+    pad = [(0, 0)] * x.ndim
+    pad[ax] = (h, h)
+    xpad = xp.pad(x, pad, mode=mode)
+    acc = None
+    for off in range(w):
+        sl = [slice(None)] * x.ndim
+        sl[ax] = slice(off, off + length)
+        piece = xpad[tuple(sl)]
+        acc = piece if acc is None else acc + piece
+    return acc / w
+
+
+def smooth(b, width, axis=None, size="150", mode="constant"):
+    """Separable moving-average (boxcar) filter along value axes — the
+    Thunder-style spatial smoothing workload, one halo-padded blockwise
+    program per backend.
+
+    ``width``: odd window (scalar or per-``axis``); ``axis``: the value
+    axes to filter (default: all); ``size``: chunk plan for the blockwise
+    execution; ``mode``: boundary handling at the ARRAY edges —
+    ``'constant'`` (zeros, numpy ``convolve 'same'`` semantics),
+    ``'reflect'`` or ``'edge'``.  Boundary modes stay exact under
+    chunking because an edge block's clipped halo ends exactly at the
+    array boundary.  Floating inputs keep their dtype; integers promote
+    through the mean's true division.
+    """
+    if mode not in _PAD_MODES:
+        raise ValueError("mode must be one of %s, got %r"
+                         % (_PAD_MODES, mode))
+    split = b.split if b.mode == "tpu" else 1
+    vshape = b.shape[split:]
+    # widths bind to the axes in the ORDER the caller gave them; the
+    # chunk layer re-sorts (axis, depth) pairs together via chunk_align
+    axes = (chunk_axes(vshape, None) if axis is None
+            else tuple(tupleize(axis)))
+    chunk_axes(vshape, axes)  # validate (range, uniqueness)
+    widths = [int(w) for w in iterexpand(width, len(axes))]
+    for w in widths:
+        if w < 1 or w % 2 == 0:
+            raise ValueError("smoothing width must be odd and >= 1, got %d" % w)
+    depth = tuple(w // 2 for w in widths)
+
+    def boxfilter(blk):
+        xp = np if isinstance(blk, np.ndarray) else jnp
+        out = blk
+        for ax, w in zip(axes, widths):
+            if w > 1:
+                out = _box1d(out, ax, w, mode, xp)
+        return out
+
+    return map_overlap(b, boxfilter, depth, axis=axes, size=size)

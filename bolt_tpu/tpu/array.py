@@ -45,7 +45,9 @@ import jax.numpy as jnp
 
 from bolt_tpu.base import BoltArray
 from bolt_tpu.parallel.sharding import key_sharding
-from bolt_tpu.utils import argpack, inshape, isreshapeable, istransposeable, prod, tupleize
+from bolt_tpu.utils import (argpack, check_value_shape as _check_value_shape,
+                            inshape, isreshapeable, istransposeable, prod,
+                            tupleize)
 
 # Compiled-executable cache keyed on (operation, user function, static
 # geometry): repeated calls with the same func/shape reuse the executable
@@ -102,14 +104,6 @@ def _traceable(func):
     return func
 
 
-def _check_value_shape(hint, inferred):
-    """Validate an explicit ``value_shape`` hint against the inferred
-    per-record output shape (shared by the array/chunked/stacked maps)."""
-    if hint is None or inferred is None:
-        return
-    if tuple(tupleize(hint)) != tuple(inferred):
-        raise ValueError("value_shape %s does not match inferred %s"
-                         % (tuple(tupleize(hint)), tuple(inferred)))
 
 
 def _canon(dtype):

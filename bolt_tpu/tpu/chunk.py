@@ -28,7 +28,7 @@ from bolt_tpu.parallel.sharding import combined_spec
 from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _canon,
                                 _chain_apply, _check_live,
                                 _check_value_shape, _constrain, _traceable)
-from bolt_tpu.utils import (chunk_axes, chunk_pad, chunk_plan, iterexpand,
+from bolt_tpu.utils import (chunk_align, chunk_pad, chunk_plan, iterexpand,
                             tupleize)
 
 
@@ -104,7 +104,7 @@ class ChunkedArray:
         """
         split = barray.split
         vshape = barray.shape[split:]
-        axes = chunk_axes(vshape, axis)
+        axes, size, padding = chunk_align(vshape, axis, size, padding)
         plan = chunk_plan(vshape, barray.dtype.itemsize, size, axes)
         pad = chunk_pad(plan, axes, padding, len(vshape))
         return cls(barray, plan, pad)
@@ -401,6 +401,10 @@ class ChunkedArray:
         moved = [self._barray.shape[a] for a in axes]
         if size is not None:
             sizes = iterexpand(size, len(moved))
+            for s in sizes:
+                if int(s) < 1:
+                    raise ValueError(
+                        "chunk size must be >= 1, got %d" % int(s))
             moved = [min(int(s), m) for s, m in zip(sizes, moved)]
         new_plan = tuple(moved) + self._plan
         new_pad = (0,) * len(moved) + self._padding

@@ -183,11 +183,48 @@ def chunk_axes(vshape, axis):
     if axis is None:
         return tuple(range(nv))
     axes = tuple(sorted(tupleize(axis)))
+    if len(set(axes)) != len(axes):
+        raise ValueError("chunk axes must be unique")
     for a in axes:
         if a < 0 or a >= nv:
             raise ValueError(
                 "chunk axis %d out of range for %d value axes" % (a, nv))
     return axes
+
+
+def chunk_align(vshape, axis, size, padding):
+    """Normalize a chunk request to sorted axes WITHOUT breaking the
+    pairing between each named axis and its per-axis ``size``/``padding``
+    entry: ``chunk(size=(2, 9), axis=(1, 0))`` means size 2 on value axis
+    1 and 9 on axis 0, whatever order downstream planning iterates in.
+    Returns ``(axes_sorted, size, padding)`` with sequence-valued
+    ``size``/``padding`` reordered to match ``axes_sorted``."""
+    if axis is None:
+        return chunk_axes(vshape, None), size, padding
+    axes_given = tuple(tupleize(axis))
+    axes = chunk_axes(vshape, axes_given)  # validates + sorts
+    order = sorted(range(len(axes_given)), key=lambda i: axes_given[i])
+
+    def reorder(arg):
+        if isinstance(arg, (tuple, list, np.ndarray)):
+            t = iterexpand(arg, len(axes))
+            return tuple(t[i] for i in order)
+        return arg
+
+    size = size if isinstance(size, str) else reorder(size)
+    padding = None if padding is None else reorder(padding)
+    return axes, size, padding
+
+
+def check_value_shape(hint, inferred):
+    """Validate an explicit ``value_shape`` hint against the inferred
+    per-record output shape (shared by every backend's array/chunked/
+    stacked map)."""
+    if hint is None or inferred is None:
+        return
+    if tuple(tupleize(hint)) != tuple(inferred):
+        raise ValueError("value_shape %s does not match inferred %s"
+                         % (tuple(tupleize(hint)), tuple(inferred)))
 
 
 def chunk_plan(vshape, itemsize, size, axes):

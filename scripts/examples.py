@@ -83,6 +83,12 @@ def main():
     # interior exact (boundaries differ: np.roll wraps on the full array)
     assert np.allclose(got[:, 1:-1], full[:, 1:-1], atol=1e-5)
 
+    # the packaged form: ops.smooth (zero boundary) matches the raw
+    # chunk-padding pipeline away from the array edges
+    from bolt_tpu.ops import smooth as box_smooth
+    got2 = box_smooth(lb, 3, axis=(0,), size=(5000,)).toarray()
+    assert np.allclose(got2[:, 1:-1], full[:, 1:-1], atol=1e-5)
+
     # ------------------------------------------------------------------
     section("5. tall-skinny PCA via per-chunk SVD (BASELINE config 5)")
     npts, nfeat = 32768, 16
