@@ -38,10 +38,12 @@ def map_overlap(b, func, depth, axis=None, size="150", value_shape=None,
     return c.map(func, value_shape=value_shape, dtype=dtype).unchunk()
 
 
-def _box1d(x, ax, w, mode, xp):
-    """Windowed mean of width ``w`` along ``ax`` ('same' size, boundary per
-    ``mode``) — the sum of ``w`` shifted slices of the padded array, which
-    is exact (no cumsum cancellation) for the small widths filters use."""
+def _filter1d(x, ax, taps, mode, xp):
+    """Correlation of ``x`` with the 1-d ``taps`` along ``ax`` ('same'
+    size, boundary per ``mode``) — the weighted sum of ``len(taps)``
+    shifted slices of the padded array, which is exact (no cumsum
+    cancellation) for the small widths filters use."""
+    w = len(taps)
     h = w // 2
     length = x.shape[ax]
     pad = [(0, 0)] * x.ndim
@@ -51,9 +53,40 @@ def _box1d(x, ax, w, mode, xp):
     for off in range(w):
         sl = [slice(None)] * x.ndim
         sl[ax] = slice(off, off + length)
-        piece = xpad[tuple(sl)]
+        piece = xpad[tuple(sl)] * taps[off]
         acc = piece if acc is None else acc + piece
-    return acc / w
+    return acc
+
+
+def _separable_filter(b, taps_list, axes, size, mode):
+    """Shared core of :func:`smooth`/:func:`convolve`/:func:`gaussian`:
+    one halo-padded blockwise program applying a 1-d tap filter per axis."""
+    if mode not in _PAD_MODES:
+        raise ValueError("mode must be one of %s, got %r"
+                         % (_PAD_MODES, mode))
+    depth = tuple(len(t) // 2 for t in taps_list)
+
+    def sepfilter(blk):
+        xp = np if isinstance(blk, np.ndarray) else jnp
+        out = blk
+        for ax, taps in zip(axes, taps_list):
+            if len(taps) > 1 or taps[0] != 1.0:  # skip only the identity
+                out = _filter1d(out, ax, taps, mode, xp)
+        return out
+
+    return map_overlap(b, sepfilter, depth, axis=axes, size=size)
+
+
+def _filter_axes(b, axis):
+    """Value axes for a filtering op, in the caller's order (widths/taps
+    bind to the axes as given; the chunk layer re-sorts (axis, depth)
+    pairs together via ``chunk_align``)."""
+    split = b.split if b.mode == "tpu" else 1
+    vshape = b.shape[split:]
+    axes = (chunk_axes(vshape, None) if axis is None
+            else tuple(tupleize(axis)))
+    chunk_axes(vshape, axes)  # validate (range, uniqueness)
+    return axes
 
 
 def smooth(b, width, axis=None, size="150", mode="constant"):
@@ -61,37 +94,61 @@ def smooth(b, width, axis=None, size="150", mode="constant"):
     Thunder-style spatial smoothing workload, one halo-padded blockwise
     program per backend.
 
-    ``width``: odd window (scalar or per-``axis``); ``axis``: the value
-    axes to filter (default: all); ``size``: chunk plan for the blockwise
-    execution; ``mode``: boundary handling at the ARRAY edges —
-    ``'constant'`` (zeros, numpy ``convolve 'same'`` semantics),
-    ``'reflect'`` or ``'edge'``.  Boundary modes stay exact under
-    chunking because an edge block's clipped halo ends exactly at the
-    array boundary.  Floating inputs keep their dtype; integers promote
-    through the mean's true division.
+    ``width``: odd window (scalar or per-``axis``, paired in the order
+    given); ``axis``: the value axes to filter (default: all); ``size``:
+    chunk plan for the blockwise execution; ``mode``: boundary handling
+    at the ARRAY edges — ``'constant'`` (zeros, numpy ``convolve 'same'``
+    semantics), ``'reflect'`` or ``'edge'``.  Boundary modes stay exact
+    under chunking because an edge block's clipped halo ends exactly at
+    the array boundary.  Floating inputs keep their dtype; integers
+    promote through the mean's true division.
     """
-    if mode not in _PAD_MODES:
-        raise ValueError("mode must be one of %s, got %r"
-                         % (_PAD_MODES, mode))
-    split = b.split if b.mode == "tpu" else 1
-    vshape = b.shape[split:]
-    # widths bind to the axes in the ORDER the caller gave them; the
-    # chunk layer re-sorts (axis, depth) pairs together via chunk_align
-    axes = (chunk_axes(vshape, None) if axis is None
-            else tuple(tupleize(axis)))
-    chunk_axes(vshape, axes)  # validate (range, uniqueness)
+    axes = _filter_axes(b, axis)
     widths = [int(w) for w in iterexpand(width, len(axes))]
     for w in widths:
         if w < 1 or w % 2 == 0:
             raise ValueError("smoothing width must be odd and >= 1, got %d" % w)
-    depth = tuple(w // 2 for w in widths)
+    taps_list = [[1.0 / w] * w for w in widths]
+    return _separable_filter(b, taps_list, axes, size, mode)
 
-    def boxfilter(blk):
-        xp = np if isinstance(blk, np.ndarray) else jnp
-        out = blk
-        for ax, w in zip(axes, widths):
-            if w > 1:
-                out = _box1d(out, ax, w, mode, xp)
-        return out
 
-    return map_overlap(b, boxfilter, depth, axis=axes, size=size)
+def convolve(b, kernel, axis=None, size="150", mode="constant"):
+    """Separable correlation with explicit 1-d kernels along value axes.
+
+    ``kernel``: a 1-d sequence of odd length, or one such sequence per
+    ``axis`` (paired in the order given).  Orientation is correlation
+    (the filter is not flipped), matching ``scipy.ndimage``; symmetric
+    kernels — the usual case — make the distinction moot.  Same
+    boundary/chunking semantics as :func:`smooth`.
+    """
+    axes = _filter_axes(b, axis)
+    kern = list(kernel)
+    if kern and np.isscalar(kern[0]):
+        taps_list = [[float(t) for t in kern]] * len(axes)
+    else:
+        if len(kern) != len(axes):
+            raise ValueError("expected %d kernels for %d axes, got %d"
+                             % (len(axes), len(axes), len(kern)))
+        taps_list = [[float(t) for t in k] for k in kern]
+    for taps in taps_list:
+        if len(taps) < 1 or len(taps) % 2 == 0:
+            raise ValueError(
+                "kernel length must be odd and >= 1, got %d" % len(taps))
+    return _separable_filter(b, taps_list, axes, size, mode)
+
+
+def gaussian(b, sigma, axis=None, size="150", mode="constant", truncate=4.0):
+    """Separable Gaussian filter along value axes (``scipy.ndimage.
+    gaussian_filter`` tap construction: radius ``truncate * sigma``,
+    normalised).  ``sigma``: scalar or per-``axis``."""
+    axes = _filter_axes(b, axis)
+    sigmas = [float(s) for s in iterexpand(sigma, len(axes))]
+    taps_list = []
+    for s in sigmas:
+        if s < 0:
+            raise ValueError("sigma must be >= 0, got %r" % (s,))
+        radius = int(truncate * s + 0.5)
+        grid = np.arange(-radius, radius + 1, dtype=np.float64)
+        taps = np.exp(-0.5 * (grid / s) ** 2) if s > 0 else np.ones(1)
+        taps_list.append([float(t) for t in taps / taps.sum()])
+    return _separable_filter(b, taps_list, axes, size, mode)

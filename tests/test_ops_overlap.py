@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 import bolt_tpu as bolt
-from bolt_tpu.ops import map_overlap, smooth
+from bolt_tpu.ops import convolve, gaussian, map_overlap, smooth
 from bolt_tpu.utils import allclose
 
 
@@ -97,6 +97,59 @@ def test_smooth_validation():
     with pytest.raises(ValueError):
         smooth(b, 3, mode="wrap")
     assert allclose(smooth(b, 1).toarray(), _x())  # width 1 = identity
+
+
+def test_convolve_matches_npconvolve(mesh):
+    x = _x((2, 18, 6))
+    k = [0.25, 0.5, 0.25]
+    lout = convolve(bolt.array(x), k, axis=(0,), size=(5,)).toarray()
+    tout = convolve(bolt.array(x, mesh), k, axis=(0,), size=(5,)).toarray()
+    assert allclose(lout, tout)
+    # correlation orientation == convolution for symmetric kernels; use
+    # np.convolve (flipped) with the reversed kernel as the oracle
+    expect = np.apply_along_axis(
+        lambda v: np.convolve(v, np.asarray(k)[::-1], "same"), 1, x)
+    assert allclose(lout, expect)
+    # asymmetric kernel: correlation (not flipped)
+    ka = [1.0, 0.0, -1.0]
+    aout = convolve(bolt.array(x), ka, axis=(0,), size=(7,)).toarray()
+    expect = np.apply_along_axis(
+        lambda v: np.convolve(v, np.asarray(ka)[::-1], "same"), 1, x)
+    assert allclose(aout, expect)
+
+
+def test_convolve_per_axis_kernels():
+    x = _x((2, 12, 10))
+    k0, k1 = [0.25, 0.5, 0.25], [0.2, 0.2, 0.2, 0.2, 0.2]
+    out = convolve(bolt.array(x), [k0, k1], axis=(0, 1), size=(6, 5)).toarray()
+    via_smoothes = convolve(convolve(bolt.array(x), k0, axis=(0,)),
+                            k1, axis=(1,)).toarray()
+    assert allclose(out, via_smoothes)
+    with pytest.raises(ValueError):
+        convolve(bolt.array(x), [k0], axis=(0, 1))
+    with pytest.raises(ValueError):
+        convolve(bolt.array(x), [0.5, 0.5])  # even length
+    # a single-tap kernel is a pure scaling, not an identity skip
+    assert allclose(convolve(bolt.array(x), [2.0], axis=(0,)).toarray(),
+                    x * 2.0)
+
+
+def test_gaussian_parity(mesh):
+    x = _x((2, 40, 4))
+    lout = gaussian(bolt.array(x), 1.5, axis=(0,), size=(12,)).toarray()
+    tout = gaussian(bolt.array(x, mesh), 1.5, axis=(0,), size=(12,)).toarray()
+    assert allclose(lout, tout)
+    # oracle: explicit normalised gaussian taps, full-axis correlation
+    radius = int(4.0 * 1.5 + 0.5)
+    g = np.exp(-0.5 * (np.arange(-radius, radius + 1) / 1.5) ** 2)
+    g /= g.sum()
+    expect = np.apply_along_axis(
+        lambda v: np.convolve(v, g[::-1], "same"), 1, x)
+    assert allclose(lout, expect)
+    # sigma=0 is the identity
+    assert allclose(gaussian(bolt.array(x), 0.0, axis=(0,)).toarray(), x)
+    with pytest.raises(ValueError):
+        gaussian(bolt.array(x), -1.0, axis=(0,))
 
 
 def test_map_overlap_generic(mesh):

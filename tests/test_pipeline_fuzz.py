@@ -76,9 +76,32 @@ def _op_chunked_map(draw, b, x):
     if nv < 1 or x.shape[b.split] < 2:
         return b, x
     c = draw(st.integers(1, x.shape[b.split]))
-    out = b.chunk(size=(c,), axis=(0,)).map(
+    p = draw(st.integers(0, max(0, c - 1)))  # random halo: exercises the
+    out = b.chunk(size=(c,), axis=(0,), padding=p).map(   # padded/trim path
         lambda blk: blk * 2.0).unchunk()
     return out, x * 2.0
+
+
+def _op_smooth(draw, b, x):
+    from bolt_tpu.ops import smooth
+    nv = b.ndim - b.split
+    if nv < 1 or x.shape[b.split] < 3:
+        return b, x
+    length = x.shape[b.split]
+    w = draw(st.sampled_from([3, 5]))
+    c = draw(st.integers(w // 2 + 1, length))
+    out = smooth(b, w, axis=(0,), size=(c,))
+    # independent mirror: zero-padded windowed mean along the first
+    # value axis of the full array
+    ax = b.split
+    h = w // 2
+    pad = [(0, 0)] * x.ndim
+    pad[ax] = (h, h)
+    xpad = np.pad(x, pad)
+    sl = lambda o: tuple(slice(None) if i != ax else slice(o, o + length)
+                         for i in range(x.ndim))
+    mirror = sum(xpad[sl(o)] for o in range(w)) / w
+    return out, mirror
 
 
 def _op_stacked_map(draw, b, x):
@@ -109,7 +132,7 @@ def _op_keys_reshape(draw, b, x):
 
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
-        _op_concat_self, _op_keys_reshape]
+        _op_concat_self, _op_keys_reshape, _op_smooth]
 
 
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
