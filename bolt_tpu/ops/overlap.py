@@ -22,7 +22,7 @@ _PAD_MODES = ("constant", "reflect", "edge")
 
 
 def map_overlap(b, func, depth, axis=None, size="150", value_shape=None,
-                dtype=None):
+                dtype=None, shard=None):
     """Apply ``func`` to halo-padded blocks of the value axes and
     reassemble: ``b.chunk(size, axis, padding=depth).map(func).unchunk()``.
 
@@ -33,8 +33,23 @@ def map_overlap(b, func, depth, axis=None, size="150", value_shape=None,
     neighbours on the chunked axes, clipped at the array edges, so
     stencil/filter funcs compute correct values at interior block
     boundaries without any global pass.
+
+    ``shard`` (TPU backend only) splits chunked VALUE axes across mesh
+    axes — the sequence-parallel regime, for contiguous axes too long
+    for one device: a mesh-axis name (applied to the first chunked
+    axis) or a ``{value_axis: mesh_axis}`` dict.  Halos then ride
+    GSPMD's inserted neighbour collectives (ICI/DCN).
     """
+    if shard is not None and b.mode != "tpu":
+        raise ValueError("shard= needs the tpu backend (a mesh); "
+                         "mode=%r has no mesh axes" % (b.mode,))
     c = b.chunk(size=size, axis=axis, padding=depth)
+    if shard is not None:
+        if isinstance(shard, dict):
+            for va, name in sorted(shard.items()):
+                c = c.shard(name, axis=va)
+        else:
+            c = c.shard(shard)
     return c.map(func, value_shape=value_shape, dtype=dtype).unchunk()
 
 
@@ -58,7 +73,7 @@ def _filter1d(x, ax, taps, mode, xp):
     return acc
 
 
-def _separable_filter(b, taps_list, axes, size, mode):
+def _separable_filter(b, taps_list, axes, size, mode, shard=None):
     """Shared core of :func:`smooth`/:func:`convolve`/:func:`gaussian`:
     one halo-padded blockwise program applying a 1-d tap filter per axis."""
     if mode not in _PAD_MODES:
@@ -74,7 +89,8 @@ def _separable_filter(b, taps_list, axes, size, mode):
                 out = _filter1d(out, ax, taps, mode, xp)
         return out
 
-    return map_overlap(b, sepfilter, depth, axis=axes, size=size)
+    return map_overlap(b, sepfilter, depth, axis=axes, size=size,
+                       shard=shard)
 
 
 def _filter_axes(b, axis):
@@ -89,7 +105,7 @@ def _filter_axes(b, axis):
     return axes
 
 
-def smooth(b, width, axis=None, size="150", mode="constant"):
+def smooth(b, width, axis=None, size="150", mode="constant", shard=None):
     """Separable moving-average (boxcar) filter along value axes — the
     Thunder-style spatial smoothing workload, one halo-padded blockwise
     program per backend.
@@ -109,10 +125,11 @@ def smooth(b, width, axis=None, size="150", mode="constant"):
         if w < 1 or w % 2 == 0:
             raise ValueError("smoothing width must be odd and >= 1, got %d" % w)
     taps_list = [[1.0 / w] * w for w in widths]
-    return _separable_filter(b, taps_list, axes, size, mode)
+    return _separable_filter(b, taps_list, axes, size, mode, shard=shard)
 
 
-def convolve(b, kernel, axis=None, size="150", mode="constant"):
+def convolve(b, kernel, axis=None, size="150", mode="constant",
+             shard=None):
     """Separable correlation with explicit 1-d kernels along value axes.
 
     ``kernel``: a 1-d sequence of odd length, or one such sequence per
@@ -134,10 +151,11 @@ def convolve(b, kernel, axis=None, size="150", mode="constant"):
         if len(taps) < 1 or len(taps) % 2 == 0:
             raise ValueError(
                 "kernel length must be odd and >= 1, got %d" % len(taps))
-    return _separable_filter(b, taps_list, axes, size, mode)
+    return _separable_filter(b, taps_list, axes, size, mode, shard=shard)
 
 
-def gaussian(b, sigma, axis=None, size="150", mode="constant", truncate=4.0):
+def gaussian(b, sigma, axis=None, size="150", mode="constant", truncate=4.0,
+             shard=None):
     """Separable Gaussian filter along value axes (``scipy.ndimage.
     gaussian_filter`` tap construction: radius ``truncate * sigma``,
     normalised).  ``sigma``: scalar or per-``axis``."""
@@ -151,4 +169,4 @@ def gaussian(b, sigma, axis=None, size="150", mode="constant", truncate=4.0):
         grid = np.arange(-radius, radius + 1, dtype=np.float64)
         taps = np.exp(-0.5 * (grid / s) ** 2) if s > 0 else np.ones(1)
         taps_list.append([float(t) for t in taps / taps.sum()])
-    return _separable_filter(b, taps_list, axes, size, mode)
+    return _separable_filter(b, taps_list, axes, size, mode, shard=shard)

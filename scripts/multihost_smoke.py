@@ -97,6 +97,21 @@ def worker(pid):
     assert np.allclose(svals, np.linalg.svd(pxc, compute_uv=False)[:2])
     assert scores.shape == (4 * ndev, 2)
 
+    # sequence-parallel smoothing: the long value axis is split across
+    # the second mesh axis (so across PROCESSES when NPROC>1) and the
+    # filter halos ride the inserted neighbour collectives over DCN
+    if ndev % 2 == 0 and ndev > 1:
+        from bolt_tpu.ops import smooth
+        mesh2 = make_mesh((ndev // 2, 2), ("k2", "v"))
+        ylen = 24
+        y = np.arange(ndev * ylen * 3, dtype=np.float64).reshape(
+            ndev, ylen, 3)
+        b2 = bolt.array(y, mesh2, axis=(0,))
+        sm = smooth(b2, 5, axis=(0,), size=(6,), shard={0: "v"}).toarray()
+        ypad = np.pad(y, ((0, 0), (2, 2), (0, 0)))
+        expect = sum(ypad[:, o:o + ylen] for o in range(5)) / 5
+        assert np.allclose(sm, expect)
+
     print("worker %d OK" % pid, flush=True)
 
 

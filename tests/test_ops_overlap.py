@@ -90,6 +90,23 @@ def test_keys_to_values_size_validation(mesh):
         tc.keys_to_values((0,), size=0)
 
 
+def test_smooth_sharded_value_axis(mesh2d):
+    # sequence-parallel: keys on 'a', the long smoothed axis split over
+    # 'b' — halos cross the shard boundary via GSPMD collectives
+    x = _x((4, 16, 3))
+    # key axis (4) takes 'a'; 'b' stays free for the value shard (the
+    # matching search keeps greedy here: 4 % (4*2) != 0)
+    b = bolt.array(x, mesh2d, axis=(0,))
+    out = smooth(b, 5, axis=(0,), size=(4,), shard={0: "b"}).toarray()
+    oracle = smooth(bolt.array(x), 5, axis=(0,), size=(4,)).toarray()
+    assert allclose(out, oracle)
+    # string form: first chunked axis
+    out2 = smooth(b, 5, axis=(0,), size=(4,), shard="b").toarray()
+    assert allclose(out2, oracle)
+    with pytest.raises(ValueError):
+        smooth(bolt.array(x), 3, shard="b")  # local backend has no mesh
+
+
 def test_smooth_validation():
     b = bolt.array(_x())
     with pytest.raises(ValueError):
