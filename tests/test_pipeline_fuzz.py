@@ -135,6 +135,93 @@ _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_concat_self, _op_keys_reshape, _op_smooth]
 
 
+# ----------------------------------------------------------------------
+# the same game on the LOCAL backend: random chains over the NumPy-
+# subclass oracle (map/filter/chunked/stacked/smooth interplay has its
+# own state to get wrong — e.g. key_axis normalisation and view classes)
+# ----------------------------------------------------------------------
+
+def _lop_map(draw, b, x):
+    a = draw(st.sampled_from([-2.0, 0.5, 3.0]))
+    return b.map(lambda v, _a=a: v * _a, axis=(0,)), x * a
+
+
+def _lop_filter(draw, b, x):
+    if x.shape[0] < 2 or x.ndim < 2:
+        return b, x
+    thresh = draw(st.sampled_from([-0.5, 0.0, 0.5]))
+    keep = x.reshape(x.shape[0], -1).mean(axis=1) > thresh
+    return (b.filter(lambda v, _t=thresh: v.mean() > _t, axis=(0,)), x[keep])
+
+
+def _lop_chunked_map(draw, b, x):
+    if x.ndim < 2 or x.shape[1] < 2:
+        return b, x
+    c = draw(st.integers(1, x.shape[1]))
+    p = draw(st.integers(0, max(0, c - 1)))
+    out = b.chunk(size=(c,), axis=(0,), padding=p).map(
+        lambda blk: blk * 2.0).unchunk()
+    return out, x * 2.0
+
+
+def _lop_stacked_map(draw, b, x):
+    if x.shape[0] < 1:
+        return b, x
+    size = draw(st.integers(1, max(1, x.shape[0])))
+    return (b.stacked(size=size).map(lambda blk: blk - 1.0).unstack(),
+            x - 1.0)
+
+
+def _lop_smooth(draw, b, x):
+    from bolt_tpu.ops import smooth
+    if x.ndim < 2 or x.shape[1] < 3:
+        return b, x
+    length = x.shape[1]
+    w = draw(st.sampled_from([3, 5]))
+    c = draw(st.integers(w // 2 + 1, length))
+    h = w // 2
+    pad = [(0, 0)] * x.ndim
+    pad[1] = (h, h)
+    xpad = np.pad(x, pad)
+    sl = lambda o: (slice(None), slice(o, o + length))
+    mirror = sum(xpad[sl(o)] for o in range(w)) / w
+    return smooth(b, w, axis=(0,), size=(c,)), mirror
+
+
+def _lop_concat_self(draw, b, x):
+    if x.shape[0] < 1 or x.shape[0] > 8:
+        return b, x
+    return b.concatenate(b, axis=0), np.concatenate([x, x], axis=0)
+
+
+# _op_operator/_op_slice0 are backend-agnostic (plain `b + c` / `b[lo:hi]`)
+_LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _lop_filter,
+              _lop_chunked_map, _lop_stacked_map, _lop_smooth,
+              _lop_concat_self]
+
+
+@given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
+@settings(**SETTINGS)
+def test_local_random_pipelines_match_numpy(data, seed, depth):
+    rs = np.random.RandomState(seed)
+    shape = tuple(rs.randint(2, 6, size=rs.randint(2, 4)))
+    x = rs.randn(*shape)
+    b = bolt.array(x)
+    assert b.mode == "local"
+    applied = []
+    for _ in range(depth):
+        op = data.draw(st.sampled_from(_LOCAL_OPS))
+        b, x = op(data.draw, b, x)
+        applied.append(op.__name__)
+        if x.shape[0] == 0:
+            break
+    assert b.shape == x.shape, (applied, b.shape, x.shape)
+    assert allclose(b.toarray(), x), applied
+    if x.shape[0] > 0:
+        got = np.asarray(b.reduce(np.add, axis=(0,)).toarray())
+        assert np.allclose(got, x.sum(axis=0), rtol=1e-6), applied
+
+
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
 @settings(**SETTINGS)
 def test_random_pipelines_match_numpy(mesh, data, seed, depth):

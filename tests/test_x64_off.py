@@ -63,6 +63,15 @@ assert np.allclose(svals, ref, rtol=1e-4)
 u, s_, vh = tallskinny_svd(np.asarray(x64.reshape(384, 4)))
 assert np.asarray(u).dtype == np.float32
 
+# halo filters stay f32 and match the f32 local oracle (taps are python
+# floats — weakly typed, no silent f64 promotion on either backend)
+from bolt_tpu.ops import smooth
+sm = smooth(b, 3, axis=(0,), size=(3,))
+assert sm.dtype == np.float32
+lo = smooth(bolt.array(x32), 3, axis=(0,), size=(3,))
+assert lo.dtype == np.float32
+assert np.allclose(sm.toarray(), lo.toarray(), rtol=1e-6, atol=1e-6)
+
 print("X64-OFF-OK")
 """
 
