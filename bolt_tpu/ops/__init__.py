@@ -1,8 +1,10 @@
 from bolt_tpu.ops.kernels import fused_map_reduce, fused_stats
-from bolt_tpu.ops.linalg import (jacobi_eigh, lstsq, pca, svdvals,
-                                 tallskinny_pca, tallskinny_svd, tsqr)
+from bolt_tpu.ops.linalg import (corrcoef, cov, jacobi_eigh, lstsq, pca,
+                                 svdvals, tallskinny_pca, tallskinny_svd,
+                                 tsqr)
 from bolt_tpu.ops.overlap import convolve, gaussian, map_overlap, smooth
 
-__all__ = ["convolve", "fused_map_reduce", "fused_stats", "gaussian",
-           "jacobi_eigh", "lstsq", "map_overlap", "pca", "smooth",
-           "svdvals", "tallskinny_pca", "tallskinny_svd", "tsqr"]
+__all__ = ["convolve", "corrcoef", "cov", "fused_map_reduce",
+           "fused_stats", "gaussian", "jacobi_eigh", "lstsq",
+           "map_overlap", "pca", "smooth", "svdvals", "tallskinny_pca",
+           "tallskinny_svd", "tsqr"]

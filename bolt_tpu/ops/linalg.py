@@ -461,27 +461,9 @@ def pca(b, k=None, center=False, axis=None, return_mean=False):
     when ``center=False``) — needed to project NEW data consistently:
     ``scores_new = (x_new - mean) @ components``.
     """
-    from bolt_tpu.utils import tupleize
-
-    mode = getattr(b, "mode", None)
-    if mode not in ("local", "tpu"):
-        raise TypeError("pca expects a bolt array (mode 'local' or 'tpu'); "
-                        "for plain matrices use tallskinny_pca")
-    if mode == "tpu":
-        axes = sorted(tupleize(axis)) if axis is not None \
-            else list(range(b.split))
-        b = b._align(axes)
-        split = b.split
-        x_full = None
-    else:
-        axes = sorted(tupleize(axis)) if axis is not None else [0]
-        split = len(axes)
-        # move sample axes to the front (the local analog of _align)
-        x_full = np.moveaxis(np.asarray(b), axes, range(split))
-    shape = b.shape if mode == "tpu" else x_full.shape
+    mode, b, x_full, split, shape, n, d = _samples_features(
+        b, axis, "pca", hint="; for plain matrices use tallskinny_pca")
     kshape = shape[:split]
-    vshape = shape[split:]
-    n, d = prod(kshape), prod(vshape)
     if n < d:
         raise ValueError(
             "pca requires #samples >= #features (got %d x %d); swap your "
@@ -550,3 +532,96 @@ def tallskinny_pca(x, k=None):
     x = _widen(jnp.asarray(x), jnp)
     vec, ev = _gram_decompose(x, _check_k(k, d), jnp, _tpu_eigh)
     return vec.astype(x.dtype), jnp.sqrt(ev).astype(_real_dtype(x.dtype))
+
+
+def _samples_features(b, axis, name, hint=""):
+    """Shared samples×features preamble for :func:`pca`/:func:`cov`:
+    mode dispatch, sample-axis resolution (``_align`` on TPU, moveaxis
+    locally), and the flattened (n, d) sizes.  Returns
+    ``(mode, b, x_full, split, shape, n, d)`` where ``x_full`` is the
+    axis-aligned host array in local mode (None on TPU)."""
+    from bolt_tpu.utils import tupleize
+
+    mode = getattr(b, "mode", None)
+    if mode not in ("local", "tpu"):
+        raise TypeError("%s expects a bolt array (mode 'local' or 'tpu')%s"
+                        % (name, hint))
+    if mode == "tpu":
+        axes = sorted(tupleize(axis)) if axis is not None \
+            else list(range(b.split))
+        b = b._align(axes)
+        split = b.split
+        x_full = None
+        shape = b.shape
+    else:
+        axes = sorted(tupleize(axis)) if axis is not None else [0]
+        split = len(axes)
+        # move sample axes to the front (the local analog of _align)
+        x_full = np.moveaxis(np.asarray(b), axes, range(split))
+        shape = x_full.shape
+    return mode, b, x_full, split, shape, prod(shape[:split]), prod(shape[split:])
+
+
+def cov(b, axis=None, center=True, ddof=1, return_mean=False):
+    """Feature-covariance matrix of a bolt array viewed as samples ×
+    features, in ONE compiled SPMD program.
+
+    Same sample/feature split as :func:`pca` (``axis`` names the sample
+    axes, defaulting to the key axes / axis 0 locally; features are the
+    flattened remaining axes): the centred Gram matmul runs shard-local
+    on the MXU and GSPMD all-reduces the (d, d) partial products — data
+    never gathers.  ``ddof=1`` gives the sample covariance (numpy's
+    ``np.cov`` default); ``center=False`` divides the raw second moment
+    ``X^T X`` by ``n - ddof`` instead.  Returns a (d, d) NumPy array;
+    ``return_mean=True`` appends the per-feature mean.  Superset of the
+    reference (its ecosystem computes this via per-chunk jobs)."""
+    mode, b, x_full, split, shape, n, d = _samples_features(b, axis, "cov")
+    if n - ddof <= 0:
+        raise ValueError("cov needs more than ddof=%d samples, got %d"
+                         % (ddof, n))
+
+    if mode == "local":
+        x = _widen(x_full.reshape(n, d), np)
+        mu = x.mean(axis=0) if center else np.zeros(d, x.dtype)
+        if center:
+            x = x - mu
+        # np.cov convention: C_ij = E[(x_i - mu_i) conj(x_j - mu_j)] —
+        # the conjugate is on the SECOND factor
+        c = (x.T @ np.conj(x)) / (n - ddof)
+        return (c, mu) if return_mean else c
+
+    from bolt_tpu.tpu.array import _cached_jit, _chain_apply
+    base, funcs = b._chain_parts()
+    mesh = b._mesh
+
+    def build():
+        def program(data):
+            mapped = _chain_apply(funcs, split, data)
+            x = _widen(mapped.reshape((n, d)), jnp)
+            mu = jnp.mean(x, axis=0) if center else jnp.zeros(d, x.dtype)
+            if center:
+                x = x - mu
+            # same second-factor conjugation as the local path / np.cov
+            c = jnp.matmul(jnp.swapaxes(x, -1, -2), jnp.conj(x),
+                           precision="highest") / (n - ddof)
+            return c, mu
+        return jax.jit(program)
+
+    fn = _cached_jit(("ops-cov", funcs, base.shape, str(base.dtype), split,
+                      mesh, center, ddof), build)
+    c, mu = fn(base)
+    c = np.asarray(jax.device_get(c))
+    return (c, np.asarray(jax.device_get(mu))) if return_mean else c
+
+
+def corrcoef(b, axis=None):
+    """Feature-correlation matrix (Pearson) of a bolt array viewed as
+    samples × features: :func:`cov` normalised by the outer product of
+    the per-feature standard deviations (the (d, d) result is tiny, so
+    the normalisation runs on host).  Zero-variance features yield
+    NaN rows/columns, matching ``np.corrcoef``."""
+    c = cov(b, axis=axis, center=True, ddof=1)
+    sd = np.sqrt(np.diag(c))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        r = c / np.outer(sd, sd)
+    return r

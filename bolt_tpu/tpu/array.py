@@ -609,6 +609,54 @@ class BoltArrayTPU(BoltArray):
         from bolt_tpu.tpu.stats import welford
         return welford(self, requested=requested, axis=axis)
 
+    def quantile(self, q, axis=None, keepdims=False, method="linear"):
+        """The ``q``-th quantile over ``axis`` (default: all key axes) —
+        one compiled program (XLA sorts on device; GSPMD gathers the
+        reduced axes as needed).  ``q`` is a scalar in [0, 1]; superset of
+        the reference (no quantiles in Bolt/StatCounter)."""
+        try:
+            q = float(q)
+        except (TypeError, ValueError):
+            raise ValueError(
+                "q must be a scalar in [0, 1] (per-q results would "
+                "prepend an axis that is neither key nor value); call "
+                "quantile once per q")
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be in [0, 1], got %r" % (q,))
+        if axis is None:
+            axes = tuple(range(self._split)) if self._split \
+                else tuple(range(self.ndim))
+        else:
+            axes = tuple(sorted(tupleize(axis)))
+            inshape(self.shape, axes)
+        mesh = self._mesh
+        split = self._split
+        nkeys_reduced = sum(1 for a in axes if a < split)
+        new_split = split if keepdims else split - nkeys_reduced
+        base, funcs = self._chain_parts()
+
+        def build():
+            # q is a traced ARGUMENT, not a trace constant: sweeping many
+            # quantiles reuses one compiled program instead of recompiling
+            # (and re-caching) per q
+            def stat(data, qv):
+                mapped = _chain_apply(funcs, split, data)
+                xf = mapped.astype(jnp.promote_types(mapped.dtype,
+                                                     jnp.float32))
+                out = jnp.quantile(xf, jnp.asarray(qv, xf.dtype), axis=axes,
+                                   keepdims=keepdims, method=method)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(stat)
+
+        fn = _cached_jit(("quantile", method, funcs, base.shape,
+                          str(base.dtype), split, axes, keepdims, mesh),
+                         build)
+        return self._wrap(fn(_check_live(base), q), new_split)
+
+    def median(self, axis=None, keepdims=False):
+        """Median over ``axis`` (default: all key axes)."""
+        return self.quantile(0.5, axis=axis, keepdims=keepdims)
+
     # ------------------------------------------------------------------
     # elementwise operators
     #

@@ -1,0 +1,103 @@
+"""quantile/median on both backends and ops.cov/corrcoef: parity against
+NumPy (np.quantile / np.cov / np.corrcoef).  Superset of the reference
+(Bolt/StatCounter has no quantiles or covariance)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.ops import corrcoef, cov
+from bolt_tpu.utils import allclose
+
+
+def _x(shape=(16, 5, 4)):
+    rs = np.random.RandomState(21)
+    return rs.randn(*shape)
+
+
+@pytest.mark.parametrize("q", [0.0, 0.25, 0.5, 0.9, 1.0])
+def test_quantile_parity(mesh, q):
+    x = _x()
+    t = bolt.array(x, mesh, axis=(0,)).quantile(q)
+    l = bolt.array(x).quantile(q)
+    expect = np.quantile(x, q, axis=0)
+    assert allclose(t.toarray(), expect)
+    assert allclose(l.toarray(), expect)
+
+
+def test_quantile_axes_and_median(mesh):
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    # default: all key axes
+    assert allclose(b.quantile(0.5).toarray(), np.median(x, axis=(0, 1)))
+    assert allclose(b.median().toarray(), np.median(x, axis=(0, 1)))
+    # explicit value axis; keepdims
+    assert allclose(b.quantile(0.75, axis=(2,)).toarray(),
+                    np.quantile(x, 0.75, axis=2))
+    assert allclose(b.median(axis=(0,), keepdims=True).toarray(),
+                    np.median(x, axis=0, keepdims=True))
+    # local axis arg; axis=None means the leading axis (stats convention)
+    assert allclose(bolt.array(x).median(axis=(1,)).toarray(),
+                    np.median(x, axis=1))
+    assert allclose(bolt.array(x).quantile(0.5, axis=None).toarray(),
+                    np.median(x, axis=0))
+    # a q-sweep hits ONE compiled program (q is a runtime argument)
+    bq = bolt.array(x, mesh)
+    for q in np.linspace(0.1, 0.9, 5):
+        assert allclose(bq.quantile(float(q)).toarray(),
+                        np.quantile(x, q, axis=0))
+    # a deferred map chain fuses into the quantile program
+    assert allclose(bolt.array(x, mesh).map(lambda v: v * 2).median().toarray(),
+                    np.median(x * 2, axis=0))
+
+
+def test_quantile_validation(mesh):
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError):
+        b.quantile(1.5)
+    with pytest.raises(ValueError):
+        b.quantile([0.2, 0.8])           # scalar-only contract
+    with pytest.raises(ValueError):
+        bolt.array(_x()).quantile((0.2, 0.8))
+
+
+def test_cov_parity(mesh):
+    x = _x((32, 6))
+    expect = np.cov(x, rowvar=False)
+    t = cov(bolt.array(x, mesh, axis=(0,)))
+    l = cov(bolt.array(x))
+    assert allclose(t, expect, rtol=1e-6)
+    assert allclose(l, expect, rtol=1e-6)
+    # multi-axis samples/features flatten like pca's convention
+    y = _x((8, 4, 3))
+    ty = cov(bolt.array(y, mesh, axis=(0,)))
+    assert allclose(ty, np.cov(y.reshape(8, 12), rowvar=False), rtol=1e-6)
+    # uncentered second moment; ddof=0
+    t0 = cov(bolt.array(x, mesh), center=False, ddof=0)
+    assert allclose(t0, x.T @ x / 32, rtol=1e-6)
+    # mean comes back on request; deferred chains fuse in
+    c, mu = cov(bolt.array(x, mesh).map(lambda v: v + 1), return_mean=True)
+    assert allclose(mu, x.mean(axis=0) + 1, rtol=1e-6)
+    assert allclose(c, expect, rtol=1e-6)
+    with pytest.raises(ValueError):
+        cov(bolt.array(_x((1, 4))), ddof=1)
+    with pytest.raises(TypeError):
+        cov(np.ones((4, 4)))
+
+
+def test_cov_complex(mesh):
+    # np.cov conjugates the SECOND factor; both backends must match it
+    rs = np.random.RandomState(13)
+    xc = rs.randn(32, 4) + 1j * rs.randn(32, 4)
+    expect = np.cov(xc, rowvar=False)
+    assert allclose(cov(bolt.array(xc)), expect, rtol=1e-6)
+    assert allclose(cov(bolt.array(xc, mesh)), expect, rtol=1e-6)
+
+
+def test_corrcoef_parity(mesh):
+    x = _x((24, 5))
+    expect = np.corrcoef(x, rowvar=False)
+    assert allclose(corrcoef(bolt.array(x, mesh)), expect, rtol=1e-6)
+    assert allclose(corrcoef(bolt.array(x)), expect, rtol=1e-6)
+    assert allclose(np.diag(corrcoef(bolt.array(x, mesh))), np.ones(5),
+                    rtol=1e-6)
