@@ -2,9 +2,12 @@ from bolt_tpu.ops.kernels import fused_map_reduce, fused_stats
 from bolt_tpu.ops.linalg import (corrcoef, cov, jacobi_eigh, lstsq, pca,
                                  svdvals, tallskinny_pca, tallskinny_svd,
                                  tsqr)
-from bolt_tpu.ops.overlap import convolve, gaussian, map_overlap, smooth
+from bolt_tpu.ops.overlap import (convolve, gaussian, map_overlap,
+                                  median_filter, smooth)
+from bolt_tpu.ops.series import center, detrend, zscore
 
-__all__ = ["convolve", "corrcoef", "cov", "fused_map_reduce",
-           "fused_stats", "gaussian", "jacobi_eigh", "lstsq",
-           "map_overlap", "pca", "smooth", "svdvals", "tallskinny_pca",
-           "tallskinny_svd", "tsqr"]
+__all__ = ["center", "convolve", "corrcoef", "cov", "detrend",
+           "fused_map_reduce", "fused_stats", "gaussian", "jacobi_eigh",
+           "lstsq", "map_overlap", "median_filter", "pca", "smooth",
+           "svdvals", "tallskinny_pca", "tallskinny_svd", "tsqr",
+           "zscore"]

@@ -18,7 +18,19 @@ import jax.numpy as jnp
 
 from bolt_tpu.utils import chunk_axes, iterexpand, tupleize
 
-_PAD_MODES = ("constant", "reflect", "edge")
+# boundary-mode names follow numpy.pad; scipy.ndimage's names are accepted
+# as aliases (scipy 'reflect' repeats the edge sample = np 'symmetric';
+# scipy 'mirror' excludes it = np 'reflect'; scipy 'nearest' = np 'edge')
+_PAD_MODES = ("constant", "reflect", "edge", "symmetric")
+_MODE_ALIASES = {"mirror": "reflect", "nearest": "edge"}
+
+
+def _canon_mode(mode):
+    mode = _MODE_ALIASES.get(mode, mode)
+    if mode not in _PAD_MODES:
+        raise ValueError("mode must be one of %s (or scipy aliases %s), "
+                         "got %r" % (_PAD_MODES, tuple(_MODE_ALIASES), mode))
+    return mode
 
 
 def map_overlap(b, func, depth, axis=None, size="150", value_shape=None,
@@ -53,17 +65,33 @@ def map_overlap(b, func, depth, axis=None, size="150", value_shape=None,
     return c.map(func, value_shape=value_shape, dtype=dtype).unchunk()
 
 
+def _odd_widths(width, n):
+    """Validate per-axis window widths: odd and >= 1 (shared by the whole
+    filter family — a symmetric window needs an integer radius)."""
+    widths = [int(w) for w in iterexpand(width, n)]
+    for w in widths:
+        if w < 1 or w % 2 == 0:
+            raise ValueError("filter width must be odd and >= 1, got %d" % w)
+    return widths
+
+
+def _halo_pad(x, axes, widths, mode, xp):
+    """Pad ``x`` by each window's radius on its axis with boundary
+    ``mode`` (the shared pad step before any shifted-slice window)."""
+    pad = [(0, 0)] * x.ndim
+    for ax, w in zip(axes, widths):
+        pad[ax] = (w // 2, w // 2)
+    return xp.pad(x, pad, mode=mode)
+
+
 def _filter1d(x, ax, taps, mode, xp):
     """Correlation of ``x`` with the 1-d ``taps`` along ``ax`` ('same'
     size, boundary per ``mode``) — the weighted sum of ``len(taps)``
     shifted slices of the padded array, which is exact (no cumsum
     cancellation) for the small widths filters use."""
     w = len(taps)
-    h = w // 2
     length = x.shape[ax]
-    pad = [(0, 0)] * x.ndim
-    pad[ax] = (h, h)
-    xpad = xp.pad(x, pad, mode=mode)
+    xpad = _halo_pad(x, [ax], [w], mode, xp)
     acc = None
     for off in range(w):
         sl = [slice(None)] * x.ndim
@@ -76,9 +104,7 @@ def _filter1d(x, ax, taps, mode, xp):
 def _separable_filter(b, taps_list, axes, size, mode, shard=None):
     """Shared core of :func:`smooth`/:func:`convolve`/:func:`gaussian`:
     one halo-padded blockwise program applying a 1-d tap filter per axis."""
-    if mode not in _PAD_MODES:
-        raise ValueError("mode must be one of %s, got %r"
-                         % (_PAD_MODES, mode))
+    mode = _canon_mode(mode)
     depth = tuple(len(t) // 2 for t in taps_list)
 
     def sepfilter(blk):
@@ -114,16 +140,15 @@ def smooth(b, width, axis=None, size="150", mode="constant", shard=None):
     given); ``axis``: the value axes to filter (default: all); ``size``:
     chunk plan for the blockwise execution; ``mode``: boundary handling
     at the ARRAY edges — ``'constant'`` (zeros, numpy ``convolve 'same'``
-    semantics), ``'reflect'`` or ``'edge'``.  Boundary modes stay exact
+    semantics), ``'reflect'``, ``'edge'`` or ``'symmetric'`` (numpy.pad
+    names; scipy's ``'mirror'``/``'nearest'`` accepted as aliases —
+    see ``_canon_mode``).  Boundary modes stay exact
     under chunking because an edge block's clipped halo ends exactly at
     the array boundary.  Floating inputs keep their dtype; integers
     promote through the mean's true division.
     """
     axes = _filter_axes(b, axis)
-    widths = [int(w) for w in iterexpand(width, len(axes))]
-    for w in widths:
-        if w < 1 or w % 2 == 0:
-            raise ValueError("smoothing width must be odd and >= 1, got %d" % w)
+    widths = _odd_widths(width, len(axes))
     taps_list = [[1.0 / w] * w for w in widths]
     return _separable_filter(b, taps_list, axes, size, mode, shard=shard)
 
@@ -147,10 +172,7 @@ def convolve(b, kernel, axis=None, size="150", mode="constant",
             raise ValueError("expected %d kernels for %d axes, got %d"
                              % (len(axes), len(axes), len(kern)))
         taps_list = [[float(t) for t in k] for k in kern]
-    for taps in taps_list:
-        if len(taps) < 1 or len(taps) % 2 == 0:
-            raise ValueError(
-                "kernel length must be odd and >= 1, got %d" % len(taps))
+    _odd_widths([len(taps) for taps in taps_list], len(taps_list))
     return _separable_filter(b, taps_list, axes, size, mode, shard=shard)
 
 
@@ -170,3 +192,35 @@ def gaussian(b, sigma, axis=None, size="150", mode="constant", truncate=4.0,
         taps = np.exp(-0.5 * (grid / s) ** 2) if s > 0 else np.ones(1)
         taps_list.append([float(t) for t in taps / taps.sum()])
     return _separable_filter(b, taps_list, axes, size, mode, shard=shard)
+
+
+def median_filter(b, width, axis=None, size="150", mode="symmetric",
+                  shard=None):
+    """Windowed median filter along value axes — the joint (rectangular)
+    window over ALL named axes, matching ``scipy.ndimage.median_filter``
+    (a median is not separable, so multi-axis requests stack every
+    offset in the window product).  ``width``: odd window per axis; the
+    default boundary (np ``'symmetric'``) is scipy's default
+    (``'reflect'`` in scipy's vocabulary).  Same halo/chunking machinery
+    as the linear filters: exact at block boundaries, one compiled
+    program on TPU, `shard=` for mesh-split axes."""
+    from itertools import product as _product
+
+    mode = _canon_mode(mode)
+    axes = _filter_axes(b, axis)
+    widths = _odd_widths(width, len(axes))
+    depth = tuple(w // 2 for w in widths)
+    offsets = list(_product(*[range(w) for w in widths]))
+
+    def medfilt(blk):
+        xp = np if isinstance(blk, np.ndarray) else jnp
+        xpad = _halo_pad(blk, axes, widths, mode, xp)
+        pieces = []
+        for off in offsets:
+            sl = [slice(None)] * blk.ndim
+            for ax, o in zip(axes, off):
+                sl[ax] = slice(o, o + blk.shape[ax])
+            pieces.append(xpad[tuple(sl)])
+        return xp.median(xp.stack(pieces, axis=0), axis=0)
+
+    return map_overlap(b, medfilt, depth, axis=axes, size=size, shard=shard)

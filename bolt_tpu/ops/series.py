@@ -1,0 +1,108 @@
+"""Per-record time-series transforms: detrend, z-score.
+
+The reference ecosystem's TimeSeries workloads (Thunder: records keyed by
+pixel/channel, values = a time axis) detrend and standardise every record
+before analysis.  Here each transform is a traceable per-record ``map`` —
+it DEFERS like any map and fuses into the next action, so
+``zscore(detrend(b)).stats()`` is one compiled pass over HBM.  Both
+backends run the same math (NumPy locally — the oracle).
+
+Polynomial detrending is one matmul per record against a precomputed
+residual projector: ``R = I - A @ pinv(A)`` for the Vandermonde ``A`` of
+the requested order — MXU-shaped work, built host-side once per
+(length, order).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from bolt_tpu.utils import tupleize
+
+
+def _value_axis(b, axis):
+    """Resolve ONE value-axis index (relative to the value group)."""
+    split = b.split if b.mode == "tpu" else 1
+    nv = b.ndim - split
+    ax = int(axis)
+    if ax < 0:
+        ax += nv
+    if ax < 0 or ax >= nv:
+        raise ValueError(
+            "value axis %r out of range for %d value axes" % (axis, nv))
+    return ax, split
+
+
+def _apply_map(b, func):
+    """Per-record map on either backend (axis = the array's key axes)."""
+    if b.mode == "tpu":
+        return b.map(func, axis=tuple(range(b.split)))
+    return b.map(func, axis=(0,))
+
+
+def detrend(b, order=1, axis=0):
+    """Remove a least-squares polynomial trend of ``order`` along the
+    value axis ``axis`` of every record.
+
+    ``order=0`` removes the mean, ``order=1`` a linear trend, etc.  The
+    fit is exact (normal equations via ``pinv``, precomputed host-side),
+    and the subtraction is one matmul along the axis inside the fused
+    per-record program.
+    """
+    order = int(order)
+    if order < 0:
+        raise ValueError("order must be >= 0, got %d" % order)
+    ax, split = _value_axis(b, axis)
+    length = b.shape[split + ax]
+    if length <= order:
+        raise ValueError(
+            "axis of length %d cannot fit a degree-%d trend" % (length, order))
+    # residual projector R = I - A pinv(A): symmetric (L, L)
+    t = np.linspace(-1.0, 1.0, length)
+    a = np.vander(t, order + 1, increasing=True)
+    r = np.eye(length) - a @ np.linalg.pinv(a)
+
+    def f(v):
+        xp = np if isinstance(v, np.ndarray) else jnp
+        # promote to float: casting the projector to an int dtype would
+        # truncate it to zeros and silently return an all-zero result
+        dt = xp.promote_types(v.dtype, xp.float32)
+        proj = xp.asarray(r, dtype=dt)
+        moved = xp.moveaxis(v.astype(dt), ax, -1)
+        if xp is jnp:
+            out = jnp.matmul(moved, proj.T, precision="highest")
+        else:
+            out = moved @ proj.T
+        return xp.moveaxis(out, -1, ax)
+
+    return _apply_map(b, f)
+
+
+def zscore(b, axis=0, ddof=0, epsilon=0.0):
+    """Standardise every record along the value axis ``axis``:
+    ``(v - mean) / (std + epsilon)``.
+
+    ``ddof`` selects population (0, default — the reference StatCounter
+    convention) or sample (1) standard deviation; ``epsilon`` guards
+    constant records (otherwise they divide by zero, matching numpy's
+    nan/inf behavior).
+    """
+    ax, _ = _value_axis(b, axis)
+
+    def f(v):
+        xp = np if isinstance(v, np.ndarray) else jnp
+        mu = xp.mean(v, axis=ax, keepdims=True)
+        sd = xp.std(v, axis=ax, ddof=ddof, keepdims=True)
+        return (v - mu) / (sd + epsilon)
+
+    return _apply_map(b, f)
+
+
+def center(b, axis=0):
+    """Subtract the per-record mean along the value axis ``axis``."""
+    ax, _ = _value_axis(b, axis)
+
+    def f(v):
+        xp = np if isinstance(v, np.ndarray) else jnp
+        return v - xp.mean(v, axis=ax, keepdims=True)
+
+    return _apply_map(b, f)

@@ -1,0 +1,121 @@
+"""median_filter (scipy.ndimage oracle) and the per-record series
+transforms detrend/zscore/center — backend parity + independent oracles
+(the reference ecosystem's TimeSeries workloads)."""
+
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+import scipy.signal
+
+import bolt_tpu as bolt
+from bolt_tpu.ops import center, detrend, gaussian, median_filter, zscore
+from bolt_tpu.utils import allclose
+
+
+def _x(shape=(3, 20, 6)):
+    rs = np.random.RandomState(31)
+    return rs.randn(*shape)
+
+
+def test_median_filter_scipy_parity(mesh):
+    x = _x()
+    lout = median_filter(bolt.array(x), 3, axis=(0,), size=(6,)).toarray()
+    tout = median_filter(bolt.array(x, mesh), 3, axis=(0,),
+                         size=(6,)).toarray()
+    assert allclose(lout, tout)
+    expect = np.stack([ndi.median_filter(r, size=(3, 1), mode="reflect")
+                       for r in x])
+    assert allclose(lout, expect)
+
+
+def test_median_filter_2d_window(mesh):
+    # joint rectangular window (median is not separable)
+    x = _x((2, 12, 10))
+    lout = median_filter(bolt.array(x), (3, 5), axis=(0, 1),
+                         size=(6, 5)).toarray()
+    tout = median_filter(bolt.array(x, mesh), (3, 5), axis=(0, 1),
+                         size=(6, 5)).toarray()
+    assert allclose(lout, tout)
+    expect = np.stack([ndi.median_filter(r, size=(3, 5), mode="reflect")
+                       for r in x])
+    assert allclose(lout, expect)
+    with pytest.raises(ValueError):
+        median_filter(bolt.array(x), 2)
+
+
+def test_gaussian_scipy_parity():
+    # scipy is present in this image: gaussian taps match ndimage's.
+    # np 'reflect' == scipy 'mirror'; scipy's name is accepted as alias
+    x = _x((2, 64, 4))
+    out = gaussian(bolt.array(x), 2.0, axis=(0,), mode="reflect").toarray()
+    expect = np.stack([ndi.gaussian_filter1d(r, 2.0, axis=0, mode="mirror")
+                       for r in x])
+    assert allclose(out, expect, rtol=1e-6, atol=1e-8)
+    alias = gaussian(bolt.array(x), 2.0, axis=(0,), mode="mirror").toarray()
+    assert allclose(alias, expect, rtol=1e-6, atol=1e-8)
+    near = gaussian(bolt.array(x), 1.0, axis=(0,), mode="nearest").toarray()
+    expect_n = np.stack([ndi.gaussian_filter1d(r, 1.0, axis=0,
+                                               mode="nearest") for r in x])
+    assert allclose(near, expect_n, rtol=1e-6, atol=1e-8)
+
+
+def test_detrend_parity(mesh):
+    x = _x()
+    lout = detrend(bolt.array(x), order=1, axis=0).toarray()
+    tout = detrend(bolt.array(x, mesh), order=1, axis=0).toarray()
+    assert allclose(lout, tout, rtol=1e-6)
+    # scipy.signal.detrend removes the linear least-squares trend
+    expect = scipy.signal.detrend(x, axis=1, type="linear")
+    assert allclose(lout, expect, rtol=1e-6, atol=1e-8)
+    # order=0 == mean removal == scipy type='constant'
+    l0 = detrend(bolt.array(x), order=0).toarray()
+    assert allclose(l0, scipy.signal.detrend(x, axis=1, type="constant"),
+                    rtol=1e-8)
+    # quadratic trend is removed exactly
+    t = np.linspace(-1, 1, 20)
+    quad = 3.0 * t ** 2 + 2.0 * t - 1.0
+    y = x + quad[None, :, None]
+    l2 = detrend(bolt.array(y), order=2).toarray()
+    t2 = detrend(bolt.array(y, mesh), order=2).toarray()
+    assert allclose(l2, t2, rtol=1e-6)
+    assert allclose(l2, detrend(bolt.array(x), order=2).toarray(), rtol=1e-6)
+    # integer input promotes to float instead of truncating the
+    # projector to zeros
+    xi = (np.arange(40) ** 2).reshape(2, 20)
+    di = detrend(bolt.array(xi), order=1).toarray()
+    assert np.issubdtype(di.dtype, np.floating)
+    assert allclose(di, scipy.signal.detrend(xi.astype(float), axis=1),
+                    rtol=1e-8)
+    with pytest.raises(ValueError):
+        detrend(bolt.array(x), order=-1)
+    with pytest.raises(ValueError):
+        detrend(bolt.array(x), order=25)   # length 20 axis
+    with pytest.raises(ValueError):
+        detrend(bolt.array(x), axis=7)
+
+
+def test_detrend_fuses(mesh):
+    # detrend is a deferred map: chaining into an action is one program
+    x = _x()
+    out = detrend(bolt.array(x, mesh).map(lambda v: v * 2.0)).sum(axis=(0,))
+    expect = scipy.signal.detrend(x * 2.0, axis=1).sum(axis=0)
+    assert allclose(out.toarray(), expect, rtol=1e-6, atol=1e-7)
+
+
+def test_zscore_center_parity(mesh):
+    x = _x()
+    for ddof in (0, 1):
+        lz = zscore(bolt.array(x), axis=0, ddof=ddof).toarray()
+        tz = zscore(bolt.array(x, mesh), axis=0, ddof=ddof).toarray()
+        assert allclose(lz, tz, rtol=1e-6)
+        mu = x.mean(axis=1, keepdims=True)
+        sd = x.std(axis=1, ddof=ddof, keepdims=True)
+        assert allclose(lz, (x - mu) / sd, rtol=1e-8)
+    lc = center(bolt.array(x), axis=1).toarray()
+    tc = center(bolt.array(x, mesh), axis=1).toarray()
+    assert allclose(lc, tc, rtol=1e-8)
+    assert allclose(lc, x - x.mean(axis=2, keepdims=True), rtol=1e-8)
+    # epsilon guards constant records
+    const = np.ones((2, 5))
+    z = zscore(bolt.array(const), epsilon=1e-6).toarray()
+    assert np.allclose(z, 0.0)
