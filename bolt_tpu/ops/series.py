@@ -16,8 +16,6 @@ the requested order — MXU-shaped work, built host-side once per
 import numpy as np
 import jax.numpy as jnp
 
-from bolt_tpu.utils import tupleize
-
 
 def _value_axis(b, axis):
     """Resolve ONE value-axis index (relative to the value group)."""
@@ -56,23 +54,28 @@ def detrend(b, order=1, axis=0):
     if length <= order:
         raise ValueError(
             "axis of length %d cannot fit a degree-%d trend" % (length, order))
-    # residual projector R = I - A pinv(A): symmetric (L, L)
+    # residual = v - A @ (pinv(A) @ v): two THIN matmuls (L x (order+1)),
+    # O(L * order) per record — never materialise the (L, L) projector,
+    # which for a 40k-sample axis would be ~13 GB
     t = np.linspace(-1.0, 1.0, length)
-    a = np.vander(t, order + 1, increasing=True)
-    r = np.eye(length) - a @ np.linalg.pinv(a)
+    a_mat = np.vander(t, order + 1, increasing=True)
+    pinv_a = np.linalg.pinv(a_mat)
 
     def f(v):
         xp = np if isinstance(v, np.ndarray) else jnp
-        # promote to float: casting the projector to an int dtype would
-        # truncate it to zeros and silently return an all-zero result
+        # promote to float: casting the fit matrices to an int dtype
+        # would truncate them to zeros and silently return zeros
         dt = xp.promote_types(v.dtype, xp.float32)
-        proj = xp.asarray(r, dtype=dt)
+        a_ = xp.asarray(a_mat, dtype=dt)
+        p_ = xp.asarray(pinv_a, dtype=dt)
         moved = xp.moveaxis(v.astype(dt), ax, -1)
         if xp is jnp:
-            out = jnp.matmul(moved, proj.T, precision="highest")
+            coef = jnp.matmul(moved, p_.T, precision="highest")
+            fit = jnp.matmul(coef, a_.T, precision="highest")
         else:
-            out = moved @ proj.T
-        return xp.moveaxis(out, -1, ax)
+            coef = moved @ p_.T
+            fit = coef @ a_.T
+        return xp.moveaxis(moved - fit, -1, ax)
 
     return _apply_map(b, f)
 
