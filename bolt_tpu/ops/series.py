@@ -109,3 +109,56 @@ def center(b, axis=0):
         return v - xp.mean(v, axis=ax, keepdims=True)
 
     return _apply_map(b, f)
+
+
+def crosscorr(b, signal, lag=0, axis=0, epsilon=0.0):
+    """Per-record normalised cross-correlation with a reference
+    ``signal`` along the value axis ``axis`` (the Thunder
+    ``TimeSeries.crossCorr`` workload).
+
+    For each integer shift ``k`` in ``[-lag, lag]`` the Pearson
+    correlation between ``v[t]`` and ``signal[t - k]`` is computed over
+    their overlapping window, so the axis of length ``L`` is replaced by
+    ``2*lag + 1`` correlation values (``lag=0`` gives each record's
+    plain correlation with the signal).  A deferred map on either
+    backend; the shift loop is static (``lag`` is small), one fused
+    program on TPU.  ``epsilon`` is added to the normaliser to guard
+    constant records/windows (otherwise they divide 0/0 to NaN, like
+    ``zscore`` without its epsilon).
+    """
+    lag = int(lag)
+    if lag < 0:
+        raise ValueError("lag must be >= 0, got %d" % lag)
+    ax, split = _value_axis(b, axis)
+    length = b.shape[split + ax]
+    sig = np.asarray(signal, dtype=np.float64).ravel()
+    if sig.shape[0] != length:
+        raise ValueError(
+            "signal length %d does not match axis length %d"
+            % (sig.shape[0], length))
+    if lag >= length:
+        raise ValueError("lag %d leaves no overlap on an axis of length %d"
+                         % (lag, length))
+    # per-shift signal statistics are pure functions of the host-side
+    # signal: centre each window and take its sum-of-squares in float64
+    # here, so the traced program only does the record-side math
+    windows = []
+    for k in range(-lag, lag + 1):
+        ssub = sig[:length - k] if k >= 0 else sig[-k:]
+        sc = ssub - ssub.mean()
+        windows.append((k, sc, float(np.sum(sc * sc))))
+
+    def f(v):
+        xp = np if isinstance(v, np.ndarray) else jnp
+        dt = xp.promote_types(v.dtype, xp.float32)
+        moved = xp.moveaxis(v.astype(dt), ax, -1)
+        outs = []
+        for k, sc_np, sc_ss in windows:
+            a = moved[..., k:] if k >= 0 else moved[..., :length + k]
+            ac = a - xp.mean(a, axis=-1, keepdims=True)
+            sc = xp.asarray(sc_np, dtype=dt)
+            denom = xp.sqrt(xp.sum(ac * ac, axis=-1) * sc_ss) + epsilon
+            outs.append(xp.sum(ac * sc, axis=-1) / denom)
+        return xp.stack(outs, axis=ax)
+
+    return _apply_map(b, f)

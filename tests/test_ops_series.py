@@ -8,7 +8,8 @@ import scipy.ndimage as ndi
 import scipy.signal
 
 import bolt_tpu as bolt
-from bolt_tpu.ops import center, detrend, gaussian, median_filter, zscore
+from bolt_tpu.ops import (center, crosscorr, detrend, gaussian,
+                          median_filter, zscore)
 from bolt_tpu.utils import allclose
 
 
@@ -119,3 +120,64 @@ def test_zscore_center_parity(mesh):
     const = np.ones((2, 5))
     z = zscore(bolt.array(const), epsilon=1e-6).toarray()
     assert np.allclose(z, 0.0)
+
+
+def _pearson(a, b):
+    return np.corrcoef(a, b)[0, 1]
+
+
+def test_crosscorr_parity(mesh):
+    rs = np.random.RandomState(5)
+    x = rs.randn(6, 30)
+    sig = rs.randn(30)
+    lout = crosscorr(bolt.array(x), sig, lag=3).toarray()
+    tout = crosscorr(bolt.array(x, mesh), sig, lag=3).toarray()
+    assert lout.shape == (6, 7)
+    assert allclose(lout, tout, rtol=1e-6)
+    # independent oracle: pearson r over the overlapping window per lag
+    for i in range(6):
+        for j, k in enumerate(range(-3, 4)):
+            if k >= 0:
+                r = _pearson(x[i, k:], sig[:30 - k])
+            else:
+                r = _pearson(x[i, :30 + k], sig[-k:])
+            assert np.isclose(lout[i, j], r, rtol=1e-8), (i, k)
+    # lag=0 is each record's plain correlation with the signal
+    l0 = crosscorr(bolt.array(x), sig).toarray()
+    assert l0.shape == (6, 1)
+    assert np.isclose(l0[2, 0], _pearson(x[2], sig), rtol=1e-10)
+    # a record equal to the shifted signal peaks at that shift
+    y = np.stack([np.r_[sig[2:], np.zeros(2)]])   # y[t] = sig[t+2]
+    peak = crosscorr(bolt.array(y), sig, lag=3).toarray()[0]
+    assert np.argmax(peak) == 1                   # k = -2 -> index 1
+    assert peak[1] > 0.99
+
+
+def test_crosscorr_epsilon_guard():
+    # constant records: 0/0 without the guard; 0 with it
+    sig = np.random.RandomState(1).randn(10)
+    z = crosscorr(bolt.array(np.ones((2, 10))), sig, epsilon=1e-9).toarray()
+    assert np.isfinite(z).all() and np.allclose(z, 0.0)
+
+
+def test_crosscorr_validation():
+    x = np.random.randn(3, 10)
+    with pytest.raises(ValueError):
+        crosscorr(bolt.array(x), np.zeros(7))     # wrong length
+    with pytest.raises(ValueError):
+        crosscorr(bolt.array(x), np.zeros(10), lag=-1)
+    with pytest.raises(ValueError):
+        crosscorr(bolt.array(x), np.zeros(10), lag=10)
+
+
+def test_crosscorr_multiaxis(mesh):
+    # time on value axis 0, channels on value axis 1: correlation
+    # computed per channel, axis replaced by the lag dimension
+    rs = np.random.RandomState(9)
+    x = rs.randn(4, 20, 3)
+    sig = rs.randn(20)
+    lout = crosscorr(bolt.array(x), sig, lag=2, axis=0).toarray()
+    tout = crosscorr(bolt.array(x, mesh), sig, lag=2, axis=0).toarray()
+    assert lout.shape == (4, 5, 3)
+    assert allclose(lout, tout, rtol=1e-6)
+    assert np.isclose(lout[1, 2, 0], _pearson(x[1, :, 0], sig), rtol=1e-8)
