@@ -7,9 +7,9 @@ it DEFERS like any map and fuses into the next action, so
 ``zscore(detrend(b)).stats()`` is one compiled pass over HBM.  Both
 backends run the same math (NumPy locally — the oracle).
 
-Polynomial detrending is one matmul per record against a precomputed
-residual projector: ``R = I - A @ pinv(A)`` for the Vandermonde ``A`` of
-the requested order — MXU-shaped work, built host-side once per
+Polynomial detrending is two thin matmuls per record against the
+precomputed Vandermonde ``A`` and its pseudo-inverse (``v - A @
+(pinv(A) @ v)``) — MXU-shaped work, built host-side once per
 (length, order).
 """
 
@@ -136,9 +136,11 @@ def crosscorr(b, signal, lag=0, axis=0, epsilon=0.0):
         raise ValueError(
             "signal length %d does not match axis length %d"
             % (sig.shape[0], length))
-    if lag >= length:
-        raise ValueError("lag %d leaves no overlap on an axis of length %d"
-                         % (lag, length))
+    if lag > length - 2:
+        raise ValueError(
+            "lag %d needs at least 2 overlapping samples on an axis of "
+            "length %d (Pearson r of a single sample is undefined)"
+            % (lag, length))
     # per-shift signal statistics are pure functions of the host-side
     # signal: centre each window and take its sum-of-squares in float64
     # here, so the traced program only does the record-side math
@@ -162,3 +164,53 @@ def crosscorr(b, signal, lag=0, axis=0, epsilon=0.0):
         return xp.stack(outs, axis=ax)
 
     return _apply_map(b, f)
+
+
+def fourier(b, freq, axis=0, epsilon=0.0):
+    """Spectral coherence and phase of every record at one frequency
+    index along the value axis ``axis`` (the Thunder ``Series.fourier``
+    workload; semantics stated explicitly here since the reference
+    mount was empty — SURVEY.md §0).
+
+    Each record is mean-centred and transformed with a real FFT; at bin
+    ``freq`` (1 ≤ freq ≤ L//2, DC excluded):
+
+    * **coherence** = ``|co[freq]| / sqrt(sum_{k>=1} |co[k]|^2)`` — the
+      fraction of non-DC spectral energy at that bin (1.0 for a pure
+      sinusoid at the bin frequency);
+    * **phase** = ``angle(co[freq])`` in radians.
+
+    Returns ``(coherence, phase)`` as bolt arrays with the axis removed —
+    both still DEFERRED maps (the selection is itself a per-record map,
+    so the contract of this module holds and downstream ops fuse).
+    ``epsilon`` guards constant records, which otherwise divide 0/0 to
+    NaN (same convention as ``zscore``/``crosscorr``).  XLA lowers the
+    FFT natively on TPU.
+    """
+    freq = int(freq)
+    ax, split = _value_axis(b, axis)
+    length = b.shape[split + ax]
+    if not 1 <= freq <= length // 2:
+        raise ValueError(
+            "freq must be in [1, %d] for an axis of length %d, got %d"
+            % (length // 2, length, freq))
+
+    def f(v):
+        xp = np if isinstance(v, np.ndarray) else jnp
+        dt = xp.promote_types(v.dtype, xp.float32)
+        moved = xp.moveaxis(v.astype(dt), ax, -1)
+        y = moved - xp.mean(moved, axis=-1, keepdims=True)
+        co = xp.fft.rfft(y, axis=-1)
+        mag2 = xp.abs(co[..., 1:]) ** 2
+        coh = (xp.abs(co[..., freq])
+               / (xp.sqrt(xp.sum(mag2, axis=-1)) + epsilon))
+        ph = xp.angle(co[..., freq])
+        return xp.stack([coh, ph], axis=ax)
+
+    out = _apply_map(b, f)
+    sel = (slice(None),) * ax
+
+    def pick(i):
+        return _apply_map(out, lambda v: v[sel + (i,)])
+
+    return pick(0), pick(1)
