@@ -4,10 +4,11 @@ from bolt_tpu.ops.linalg import (corrcoef, cov, jacobi_eigh, lstsq, pca,
                                  tsqr)
 from bolt_tpu.ops.overlap import (convolve, gaussian, map_overlap,
                                   median_filter, smooth)
-from bolt_tpu.ops.series import center, crosscorr, detrend, fourier, zscore
+from bolt_tpu.ops.series import (center, crosscorr, detrend, fourier,
+                                 normalize, zscore)
 
 __all__ = ["center", "convolve", "corrcoef", "cov", "crosscorr",
            "detrend", "fourier", "fused_map_reduce", "fused_stats",
            "gaussian", "jacobi_eigh", "lstsq", "map_overlap",
-           "median_filter", "pca", "smooth", "svdvals", "tallskinny_pca",
-           "tallskinny_svd", "tsqr", "zscore"]
+           "median_filter", "normalize", "pca", "smooth", "svdvals",
+           "tallskinny_pca", "tallskinny_svd", "tsqr", "zscore"]

@@ -214,3 +214,38 @@ def fourier(b, freq, axis=0, epsilon=0.0):
         return _apply_map(out, lambda v: v[sel + (i,)])
 
     return pick(0), pick(1)
+
+
+def normalize(b, baseline="percentile", perc=20.0, axis=0, epsilon=0.0):
+    """Normalise every record to its own baseline along the value axis
+    ``axis``: ``(v - base) / (base + epsilon)`` — the ΔF/F transform of
+    the Thunder ``Series.normalize`` workload.
+
+    ``baseline``: ``'percentile'`` (the ``perc``-th per-record
+    percentile, default 20 — a robust resting level) or ``'mean'``.
+    ``epsilon`` guards baselines at/near zero.  A deferred map on either
+    backend.
+    """
+    if baseline not in ("percentile", "mean"):
+        raise ValueError(
+            "baseline must be 'percentile' or 'mean', got %r" % (baseline,))
+    perc = float(perc)
+    if not 0.0 <= perc <= 100.0:
+        raise ValueError("perc must be in [0, 100], got %r" % (perc,))
+    ax, _ = _value_axis(b, axis)
+
+    def f(v):
+        xp = np if isinstance(v, np.ndarray) else jnp
+        dt = xp.promote_types(v.dtype, xp.float32)
+        vf = v.astype(dt)
+        if baseline == "percentile":
+            base = xp.percentile(vf, perc, axis=ax, keepdims=True)
+        else:
+            base = xp.mean(vf, axis=ax, keepdims=True)
+        # sign-aware guard: the baseline is SIGNED (e.g. after detrend),
+        # so 'base + epsilon' could move a negative baseline ONTO zero;
+        # push it away from zero instead (zero itself goes to +epsilon)
+        denom = xp.where(base >= 0, base + epsilon, base - epsilon)
+        return (vf - base) / denom
+
+    return _apply_map(b, f)

@@ -227,3 +227,29 @@ def test_fourier_parity(mesh):
     assert c2.deferred
     assert allclose(c2.map(lambda v: v * 2, axis=(0,)).toarray(),
                     np.asarray(lcoh.toarray()) * 2)
+
+
+def test_normalize_parity(mesh):
+    from bolt_tpu.ops import normalize
+    rs = np.random.RandomState(23)
+    x = rs.rand(5, 40) + 0.5                    # positive baselines
+    lout = normalize(bolt.array(x), perc=20).toarray()
+    tout = normalize(bolt.array(x, mesh), perc=20).toarray()
+    assert allclose(lout, tout, rtol=1e-6)
+    base = np.percentile(x, 20, axis=1, keepdims=True)
+    assert allclose(lout, (x - base) / base, rtol=1e-8)
+    lm = normalize(bolt.array(x), baseline="mean").toarray()
+    mu = x.mean(axis=1, keepdims=True)
+    assert allclose(lm, (x - mu) / mu, rtol=1e-8)
+    # epsilon guards zero baselines
+    z = normalize(bolt.array(np.zeros((2, 8))), epsilon=1e-9).toarray()
+    assert np.isfinite(z).all()
+    # ... and NEGATIVE baselines (sign-aware: the guard must push the
+    # denominator away from zero, not across it)
+    xn = np.array([[-1e-6, -1e-6, -1e-6, 1.0]])
+    zn = normalize(bolt.array(xn), perc=20, epsilon=1e-6).toarray()
+    assert np.isfinite(zn).all()
+    with pytest.raises(ValueError):
+        normalize(bolt.array(x), baseline="windowed")
+    with pytest.raises(ValueError):
+        normalize(bolt.array(x), perc=150)

@@ -101,3 +101,14 @@ def test_corrcoef_parity(mesh):
     assert allclose(corrcoef(bolt.array(x)), expect, rtol=1e-6)
     assert allclose(np.diag(corrcoef(bolt.array(x, mesh))), np.ones(5),
                     rtol=1e-6)
+
+
+def test_quantile_cov_2d_mesh(mesh2d):
+    # multi-axis key sharding: same answers as the 1-axis layout
+    x = _x((8, 4, 6))
+    b = bolt.array(x, mesh2d, axis=(0, 1))
+    assert allclose(b.median().toarray(), np.median(x, axis=(0, 1)))
+    assert allclose(b.quantile(0.3, axis=(2,)).toarray(),
+                    np.quantile(x, 0.3, axis=2))
+    c = cov(b)
+    assert allclose(c, np.cov(x.reshape(32, 6), rowvar=False), rtol=1e-6)
