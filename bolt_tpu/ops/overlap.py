@@ -13,6 +13,8 @@ program and halos ride GSPMD's neighbour collectives; locally the same
 contract runs on NumPy (the oracle).
 """
 
+from functools import lru_cache
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -101,20 +103,29 @@ def _filter1d(x, ax, taps, mode, xp):
     return acc
 
 
+@lru_cache(maxsize=256)
+def _sepfilter_fn(taps_key, axes, mode):
+    """Memoised block function for the separable filters: identical
+    (taps, axes, mode) return the SAME callable object, so the chunked
+    map's jit cache (keyed on function identity) hits and repeated
+    filter calls dispatch in milliseconds instead of recompiling."""
+    def sepfilter(blk):
+        xp = np if isinstance(blk, np.ndarray) else jnp
+        out = blk
+        for ax, taps in zip(axes, taps_key):
+            if len(taps) > 1 or taps[0] != 1.0:  # skip only the identity
+                out = _filter1d(out, ax, taps, mode, xp)
+        return out
+    return sepfilter
+
+
 def _separable_filter(b, taps_list, axes, size, mode, shard=None):
     """Shared core of :func:`smooth`/:func:`convolve`/:func:`gaussian`:
     one halo-padded blockwise program applying a 1-d tap filter per axis."""
     mode = _canon_mode(mode)
     depth = tuple(len(t) // 2 for t in taps_list)
-
-    def sepfilter(blk):
-        xp = np if isinstance(blk, np.ndarray) else jnp
-        out = blk
-        for ax, taps in zip(axes, taps_list):
-            if len(taps) > 1 or taps[0] != 1.0:  # skip only the identity
-                out = _filter1d(out, ax, taps, mode, xp)
-        return out
-
+    taps_key = tuple(tuple(float(t) for t in taps) for taps in taps_list)
+    sepfilter = _sepfilter_fn(taps_key, tuple(axes), mode)
     return map_overlap(b, sepfilter, depth, axis=axes, size=size,
                        shard=shard)
 
@@ -204,12 +215,19 @@ def median_filter(b, width, axis=None, size="150", mode="symmetric",
     (``'reflect'`` in scipy's vocabulary).  Same halo/chunking machinery
     as the linear filters: exact at block boundaries, one compiled
     program on TPU, `shard=` for mesh-split axes."""
-    from itertools import product as _product
-
     mode = _canon_mode(mode)
     axes = _filter_axes(b, axis)
     widths = _odd_widths(width, len(axes))
     depth = tuple(w // 2 for w in widths)
+    medfilt = _medfilt_fn(tuple(axes), tuple(widths), mode)
+    return map_overlap(b, medfilt, depth, axis=axes, size=size, shard=shard)
+
+
+@lru_cache(maxsize=256)
+def _medfilt_fn(axes, widths, mode):
+    """Memoised median block function (same rationale as
+    :func:`_sepfilter_fn`)."""
+    from itertools import product as _product
     offsets = list(_product(*[range(w) for w in widths]))
 
     def medfilt(blk):
@@ -223,4 +241,4 @@ def median_filter(b, width, axis=None, size="150", mode="symmetric",
             pieces.append(xpad[tuple(sl)])
         return xp.median(xp.stack(pieces, axis=0), axis=0)
 
-    return map_overlap(b, medfilt, depth, axis=axes, size=size, shard=shard)
+    return medfilt

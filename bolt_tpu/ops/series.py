@@ -13,6 +13,8 @@ precomputed Vandermonde ``A`` and its pseudo-inverse (``v - A @
 (length, order).
 """
 
+from functools import lru_cache
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -54,9 +56,16 @@ def detrend(b, order=1, axis=0):
     if length <= order:
         raise ValueError(
             "axis of length %d cannot fit a degree-%d trend" % (length, order))
+    return _apply_map(b, _detrend_fn(length, order, ax))
+
+
+@lru_cache(maxsize=256)
+def _detrend_fn(length, order, ax):
     # residual = v - A @ (pinv(A) @ v): two THIN matmuls (L x (order+1)),
     # O(L * order) per record — never materialise the (L, L) projector,
-    # which for a 40k-sample axis would be ~13 GB
+    # which for a 40k-sample axis would be ~13 GB.  Memoised so repeated
+    # detrend calls return the SAME callable and the jit cache (keyed on
+    # function identity) hits instead of recompiling.
     t = np.linspace(-1.0, 1.0, length)
     a_mat = np.vander(t, order + 1, increasing=True)
     pinv_a = np.linalg.pinv(a_mat)
@@ -77,7 +86,7 @@ def detrend(b, order=1, axis=0):
             fit = coef @ a_.T
         return xp.moveaxis(moved - fit, -1, ax)
 
-    return _apply_map(b, f)
+    return f
 
 
 def zscore(b, axis=0, ddof=0, epsilon=0.0):
@@ -90,25 +99,31 @@ def zscore(b, axis=0, ddof=0, epsilon=0.0):
     nan/inf behavior).
     """
     ax, _ = _value_axis(b, axis)
+    return _apply_map(b, _zscore_fn(ax, int(ddof), float(epsilon)))
 
+
+@lru_cache(maxsize=256)
+def _zscore_fn(ax, ddof, epsilon):
     def f(v):
         xp = np if isinstance(v, np.ndarray) else jnp
         mu = xp.mean(v, axis=ax, keepdims=True)
         sd = xp.std(v, axis=ax, ddof=ddof, keepdims=True)
         return (v - mu) / (sd + epsilon)
-
-    return _apply_map(b, f)
+    return f
 
 
 def center(b, axis=0):
     """Subtract the per-record mean along the value axis ``axis``."""
     ax, _ = _value_axis(b, axis)
+    return _apply_map(b, _center_fn(ax))
 
+
+@lru_cache(maxsize=256)
+def _center_fn(ax):
     def f(v):
         xp = np if isinstance(v, np.ndarray) else jnp
         return v - xp.mean(v, axis=ax, keepdims=True)
-
-    return _apply_map(b, f)
+    return f
 
 
 def crosscorr(b, signal, lag=0, axis=0, epsilon=0.0):
@@ -141,9 +156,17 @@ def crosscorr(b, signal, lag=0, axis=0, epsilon=0.0):
             "lag %d needs at least 2 overlapping samples on an axis of "
             "length %d (Pearson r of a single sample is undefined)"
             % (lag, length))
+    return _apply_map(
+        b, _crosscorr_fn(sig.tobytes(), length, lag, ax, float(epsilon)))
+
+
+@lru_cache(maxsize=128)
+def _crosscorr_fn(sig_bytes, length, lag, ax, epsilon):
     # per-shift signal statistics are pure functions of the host-side
     # signal: centre each window and take its sum-of-squares in float64
-    # here, so the traced program only does the record-side math
+    # here, so the traced program only does the record-side math.
+    # Memoised by signal CONTENT so repeated calls hit the jit cache.
+    sig = np.frombuffer(sig_bytes, dtype=np.float64)
     windows = []
     for k in range(-lag, lag + 1):
         ssub = sig[:length - k] if k >= 0 else sig[-k:]
@@ -163,7 +186,7 @@ def crosscorr(b, signal, lag=0, axis=0, epsilon=0.0):
             outs.append(xp.sum(ac * sc, axis=-1) / denom)
         return xp.stack(outs, axis=ax)
 
-    return _apply_map(b, f)
+    return f
 
 
 def fourier(b, freq, axis=0, epsilon=0.0):
@@ -195,6 +218,13 @@ def fourier(b, freq, axis=0, epsilon=0.0):
             "freq must be in [1, %d] for an axis of length %d, got %d"
             % (length // 2, length, freq))
 
+    out = _apply_map(b, _fourier_fn(freq, ax, float(epsilon)))
+    return (_apply_map(out, _pick_fn(ax, 0)),
+            _apply_map(out, _pick_fn(ax, 1)))
+
+
+@lru_cache(maxsize=128)
+def _fourier_fn(freq, ax, epsilon):
     def f(v):
         xp = np if isinstance(v, np.ndarray) else jnp
         dt = xp.promote_types(v.dtype, xp.float32)
@@ -206,25 +236,26 @@ def fourier(b, freq, axis=0, epsilon=0.0):
                / (xp.sqrt(xp.sum(mag2, axis=-1)) + epsilon))
         ph = xp.angle(co[..., freq])
         return xp.stack([coh, ph], axis=ax)
+    return f
 
-    out = _apply_map(b, f)
+
+@lru_cache(maxsize=128)
+def _pick_fn(ax, i):
     sel = (slice(None),) * ax
-
-    def pick(i):
-        return _apply_map(out, lambda v: v[sel + (i,)])
-
-    return pick(0), pick(1)
+    return lambda v: v[sel + (i,)]
 
 
 def normalize(b, baseline="percentile", perc=20.0, axis=0, epsilon=0.0):
     """Normalise every record to its own baseline along the value axis
-    ``axis``: ``(v - base) / (base + epsilon)`` — the ΔF/F transform of
-    the Thunder ``Series.normalize`` workload.
+    ``axis``: ``(v - base) / denom`` with the sign-aware denominator
+    ``denom = base + epsilon`` for ``base >= 0`` and ``base - epsilon``
+    otherwise — the ΔF/F transform of the Thunder ``Series.normalize``
+    workload, with the guard pushed AWAY from zero so signed baselines
+    (e.g. after ``detrend``) cannot land the denominator on it.
 
     ``baseline``: ``'percentile'`` (the ``perc``-th per-record
     percentile, default 20 — a robust resting level) or ``'mean'``.
-    ``epsilon`` guards baselines at/near zero.  A deferred map on either
-    backend.
+    A deferred map on either backend.
     """
     if baseline not in ("percentile", "mean"):
         raise ValueError(
@@ -233,7 +264,11 @@ def normalize(b, baseline="percentile", perc=20.0, axis=0, epsilon=0.0):
     if not 0.0 <= perc <= 100.0:
         raise ValueError("perc must be in [0, 100], got %r" % (perc,))
     ax, _ = _value_axis(b, axis)
+    return _apply_map(b, _normalize_fn(baseline, perc, ax, float(epsilon)))
 
+
+@lru_cache(maxsize=128)
+def _normalize_fn(baseline, perc, ax, epsilon):
     def f(v):
         xp = np if isinstance(v, np.ndarray) else jnp
         dt = xp.promote_types(v.dtype, xp.float32)
@@ -247,5 +282,4 @@ def normalize(b, baseline="percentile", perc=20.0, axis=0, epsilon=0.0):
         # push it away from zero instead (zero itself goes to +epsilon)
         denom = xp.where(base >= 0, base + epsilon, base - epsilon)
         return (vf - base) / denom
-
-    return _apply_map(b, f)
+    return f

@@ -112,6 +112,23 @@ def _op_stacked_map(draw, b, x):
             x - 1.0)
 
 
+def _op_normalize(draw, b, x):
+    from bolt_tpu.ops import normalize
+    if b.ndim - b.split < 1 or x.shape[b.split] < 2:
+        return b, x
+    ax = b.split
+    mu = x.mean(axis=ax, keepdims=True)
+    if np.any(np.abs(mu) < 0.05):
+        # near-zero baselines sit on the sign-aware-epsilon knife edge:
+        # backend and oracle could land on opposite sides on ULP noise
+        return b, x
+    # the result is zero-mean by construction — shift it so downstream
+    # sign-sensitive ops (filter thresholds, another normalize) stay off
+    # the knife edge
+    out = normalize(b, baseline="mean") + 3.0
+    return out, (x - mu) / mu + 3.0
+
+
 def _op_concat_self(draw, b, x):
     if b.split < 1 or x.shape[0] < 1 or x.shape[0] > 8:
         return b, x
@@ -132,7 +149,7 @@ def _op_keys_reshape(draw, b, x):
 
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
-        _op_concat_self, _op_keys_reshape, _op_smooth]
+        _op_concat_self, _op_keys_reshape, _op_smooth, _op_normalize]
 
 
 # ----------------------------------------------------------------------
@@ -194,10 +211,20 @@ def _lop_concat_self(draw, b, x):
     return b.concatenate(b, axis=0), np.concatenate([x, x], axis=0)
 
 
+def _lop_normalize(draw, b, x):
+    from bolt_tpu.ops import normalize
+    if x.ndim < 2 or x.shape[1] < 2:
+        return b, x
+    mu = x.mean(axis=1, keepdims=True)
+    if np.any(np.abs(mu) < 0.05):
+        return b, x                       # knife edge — see _op_normalize
+    return (normalize(b, baseline="mean") + 3.0, (x - mu) / mu + 3.0)
+
+
 # _op_operator/_op_slice0 are backend-agnostic (plain `b + c` / `b[lo:hi]`)
 _LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _lop_filter,
               _lop_chunked_map, _lop_stacked_map, _lop_smooth,
-              _lop_concat_self]
+              _lop_concat_self, _lop_normalize]
 
 
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
