@@ -657,6 +657,49 @@ class BoltArrayTPU(BoltArray):
         """Median over ``axis`` (default: all key axes)."""
         return self.quantile(0.5, axis=axis, keepdims=keepdims)
 
+    def argmax(self, axis=None, keepdims=False):
+        """Index of the maximum along ONE axis (numpy semantics: an int
+        axis, or ``None`` for the index into the flattened array) — the
+        local backend inherits exactly this from ``ndarray``.  One
+        compiled program; ties resolve to the first occurrence, like
+        numpy."""
+        return self._arg_stat("argmax", axis, keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        """Index of the minimum along ONE axis (numpy semantics)."""
+        return self._arg_stat("argmin", axis, keepdims)
+
+    def _arg_stat(self, name, axis, keepdims):
+        if axis is not None:
+            from numbers import Integral
+            if not isinstance(axis, Integral):
+                raise ValueError("axis %r is not an integer" % (axis,))
+            axis = int(axis)
+            if axis < 0:           # numpy semantics: negative axes wrap
+                axis += self.ndim
+            inshape(self.shape, (axis,))
+        mesh = self._mesh
+        split = self._split
+        if axis is None:
+            new_split = 0
+        else:
+            new_split = split - (1 if axis < split and not keepdims else 0)
+        base, funcs = self._chain_parts()
+
+        def build():
+            op = {"argmax": jnp.argmax, "argmin": jnp.argmin}[name]
+
+            def stat(data):
+                mapped = _chain_apply(funcs, split, data)
+                out = op(mapped, axis=axis, keepdims=keepdims)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(stat)
+
+        fn = _cached_jit(("argstat", name, funcs, base.shape,
+                          str(base.dtype), split, axis, keepdims, mesh),
+                         build)
+        return self._wrap(fn(_check_live(base)), new_split)
+
     # ------------------------------------------------------------------
     # elementwise operators
     #

@@ -103,6 +103,33 @@ def test_corrcoef_parity(mesh):
                     rtol=1e-6)
 
 
+def test_argmax_argmin_parity(mesh):
+    x = _x((12, 5, 4))
+    b = bolt.array(x, mesh, axis=(0,))
+    l = bolt.array(x)                     # inherits ndarray argmax/argmin
+    for axis in (None, 0, 1, 2, -1, -2):
+        assert allclose(b.argmax(axis=axis).toarray(),
+                        np.argmax(x, axis=axis))
+        assert allclose(b.argmin(axis=axis).toarray(),
+                        np.argmin(x, axis=axis))
+        assert allclose(np.asarray(l.argmax(axis=axis)),
+                        np.argmax(x, axis=axis))
+    # keepdims; split bookkeeping (key axis reduced -> split drops)
+    assert allclose(b.argmax(axis=0, keepdims=True).toarray(),
+                    np.argmax(x, axis=0, keepdims=True))
+    assert b.argmax(axis=0).split == 0
+    assert b.argmax(axis=1).split == 1
+    # ties resolve to the first occurrence, like numpy
+    t = np.zeros((4, 3))
+    t[1] = t[3] = 7.0
+    bt = bolt.array(t, mesh)
+    assert allclose(bt.argmax(axis=0).toarray(), np.argmax(t, axis=0))
+    with pytest.raises(ValueError):
+        b.argmax(axis=9)
+    with pytest.raises(ValueError):
+        b.argmax(axis=1.9)               # non-integer axis rejected
+
+
 def test_quantile_cov_2d_mesh(mesh2d):
     # multi-axis key sharding: same answers as the 1-axis layout
     x = _x((8, 4, 6))
