@@ -37,6 +37,7 @@ sorted collect).
 """
 
 from collections import OrderedDict
+from functools import lru_cache
 
 import numpy as np
 
@@ -60,6 +61,36 @@ _JIT_CACHE_MAX = 512
 
 # stable callables for scalar operator operands (see _scalar_fn)
 _SCALAR_FN_CACHE = OrderedDict()
+
+
+def _make_clip(lo, hi, name):
+    def f(v):
+        return jnp.clip(v, lo, hi)
+    # distinct __name__ per parameterisation: _unary's split=0 jit cache
+    # keys on it, and two different clips must not share a program
+    f.__name__ = name
+    return f
+
+
+@lru_cache(maxsize=256)
+def _clip_fn(lo_key, hi_key):
+    # keys carry (type-name, value): lru_cache hashes by equality, and
+    # 0 == 0.0 == False — without the type the first caller's bound TYPE
+    # would leak into later calls and change the result dtype
+    lo = lo_key[1] if lo_key is not None else None
+    hi = hi_key[1] if hi_key is not None else None
+    return _make_clip(lo, hi, "clip_%r_%r" % (lo_key, hi_key))
+
+
+_CLIP_COUNTER = iter(range(1 << 62))
+
+
+@lru_cache(maxsize=256)
+def _round_fn(decimals):
+    def f(v):
+        return jnp.round(v, decimals)
+    f.__name__ = "round_%d" % decimals
+    return f
 
 # toarray's batched pending-filter fetch ships the FULL padded buffer to
 # save one round-trip; above this size the worst case (few survivors) costs
@@ -570,7 +601,8 @@ class BoltArrayTPU(BoltArray):
 
         def build():
             op = {"mean": jnp.mean, "var": jnp.var, "std": jnp.std,
-                  "sum": jnp.sum, "max": jnp.max, "min": jnp.min}[name]
+                  "sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+                  "prod": jnp.prod, "all": jnp.all, "any": jnp.any}[name]
 
             def stat(data):
                 mapped = _chain_apply(funcs, split, data)
@@ -601,6 +633,56 @@ class BoltArrayTPU(BoltArray):
 
     def min(self, axis=None, keepdims=False):
         return self._stat(axis, "min", keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        """Product over ``axis`` (default: all key axes) — the ndarray
+        method the local backend inherits, as one compiled program."""
+        return self._stat(axis, "prod", keepdims)
+
+    def all(self, axis=None, keepdims=False):
+        """Truth-reduction AND over ``axis`` (ndarray semantics)."""
+        return self._stat(axis, "all", keepdims)
+
+    def any(self, axis=None, keepdims=False):
+        """Truth-reduction OR over ``axis`` (ndarray semantics)."""
+        return self._stat(axis, "any", keepdims)
+
+    def cumsum(self, axis=None):
+        """Cumulative sum (ndarray semantics: int axis, negative wrap, or
+        ``None`` for the cumsum of the FLATTENED array, returned with a
+        single flat key axis like ``filter``'s output convention)."""
+        return self._cum("cumsum", axis)
+
+    def cumprod(self, axis=None):
+        """Cumulative product (ndarray semantics, see :meth:`cumsum`)."""
+        return self._cum("cumprod", axis)
+
+    def _cum(self, name, axis):
+        from numbers import Integral
+        if axis is not None:
+            if not isinstance(axis, Integral):
+                raise ValueError("axis %r is not an integer" % (axis,))
+            axis = int(axis)
+            if axis < 0:
+                axis += self.ndim
+            inshape(self.shape, (axis,))
+        mesh = self._mesh
+        split = self._split
+        new_split = (1 if split else 0) if axis is None else split
+        base, funcs = self._chain_parts()
+
+        def build():
+            op = {"cumsum": jnp.cumsum, "cumprod": jnp.cumprod}[name]
+
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                out = op(mapped, axis=axis)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("cum", name, funcs, base.shape, str(base.dtype),
+                          split, axis, mesh), build)
+        return self._wrap(fn(_check_live(base)), new_split)
 
     def stats(self, requested=("mean", "var", "std", "min", "max"), axis=None):
         """Single-pass streaming statistics via an explicit shard_map Welford
@@ -813,6 +895,44 @@ class BoltArrayTPU(BoltArray):
 
     def __abs__(self):
         return self._unary(jnp.abs)
+
+    def clip(self, min=None, max=None, a_min=None, a_max=None):
+        """Bound values to ``[min, max]`` — the ndarray method (and
+        keyword names) the local backend inherits; ``a_min``/``a_max``
+        accepted as np.clip-style aliases.  Defers/fuses like any
+        elementwise op; array-valued bounds broadcast."""
+        if a_min is not None:
+            if min is not None:
+                raise ValueError("pass min= or a_min=, not both")
+            min = a_min
+        if a_max is not None:
+            if max is not None:
+                raise ValueError("pass max= or a_max=, not both")
+            max = a_max
+        if min is None and max is None:
+            raise ValueError("clip needs at least one of min/max")
+
+        def key(v):
+            if v is None:
+                return None
+            if isinstance(v, (int, float, bool, np.number)):
+                return (type(v).__name__, v)
+            return False  # unhashable/array bound: no caching
+        lo_key, hi_key = key(min), key(max)
+        if lo_key is not False and hi_key is not False:
+            return self._unary(_clip_fn(lo_key, hi_key))
+        # array bounds: a fresh closure with a process-unique name (the
+        # split=0 jit cache keys on __name__, so names must not collide);
+        # recompiles per call, which matches map-with-a-fresh-lambda cost
+        return self._unary(_make_clip(
+            jnp.asarray(min) if min is not None else None,
+            jnp.asarray(max) if max is not None else None,
+            "clip_arr_%d" % next(_CLIP_COUNTER)))
+
+    def round(self, decimals=0):
+        """Round to ``decimals`` places (ndarray semantics; banker's
+        rounding at .5, identical on both backends)."""
+        return self._unary(_round_fn(int(decimals)))
 
     def __lt__(self, other):
         return self._elementwise(other, jnp.less)

@@ -139,3 +139,52 @@ def test_quantile_cov_2d_mesh(mesh2d):
                     np.quantile(x, 0.3, axis=2))
     c = cov(b)
     assert allclose(c, np.cov(x.reshape(32, 6), rowvar=False), rtol=1e-6)
+
+
+def test_ndarray_method_parity(mesh):
+    # methods the local backend inherits from ndarray now have TPU twins
+    x = np.abs(_x((8, 4, 3))) + 0.5
+    b = bolt.array(x, mesh, axis=(0,))
+    assert allclose(b.prod().toarray(), x.prod(axis=0))
+    assert allclose(b.prod(axis=(1,), keepdims=True).toarray(),
+                    x.prod(axis=1, keepdims=True))
+    m = b > 1.0
+    xm = x > 1.0
+    assert allclose(m.all().toarray(), xm.all(axis=0))
+    assert allclose(m.any(axis=(0, 2)).toarray(), xm.any(axis=(0, 2)))
+    assert allclose(b.clip(0.7, 1.2).toarray(), x.clip(0.7, 1.2))
+    # SAME keyword names as ndarray.clip, so portable code uses one form
+    assert allclose(b.clip(max=1.0).toarray(), x.clip(max=1.0))
+    assert allclose(b.clip(a_max=1.0).toarray(), x.clip(max=1.0))  # alias
+    assert allclose(b.round(1).toarray(), x.round(1))
+    # int bounds after float bounds keep the int dtype (type-aware cache)
+    xi = (x * 10).astype(np.int64)
+    bi = bolt.array(xi, mesh)
+    ci = bi.clip(0, 9)
+    assert ci.dtype == xi.dtype
+    assert allclose(ci.toarray(), xi.clip(0, 9))
+    # array-valued bounds broadcast, like ndarray.clip
+    lo = np.full(x.shape[2], 0.8)
+    assert allclose(b.clip(min=lo).toarray(), x.clip(min=lo))
+    with pytest.raises(ValueError):
+        b.clip()
+    with pytest.raises(ValueError):
+        b.clip(0.1, a_min=0.2)
+
+
+def test_cumsum_cumprod_parity(mesh):
+    x = _x((6, 4, 3))
+    b = bolt.array(x, mesh, axis=(0,))
+    for axis in (0, 1, 2, -1):
+        assert allclose(b.cumsum(axis=axis).toarray(), x.cumsum(axis=axis))
+        assert allclose(b.cumprod(axis=axis).toarray(),
+                        x.cumprod(axis=axis))
+    # axis=None: flattened, single flat key axis (split=1)
+    c = b.cumsum()
+    assert c.split == 1
+    assert allclose(c.toarray(), x.cumsum())
+    # deferred chains fuse in
+    assert allclose(bolt.array(x, mesh).map(lambda v: v + 1).cumsum(axis=0)
+                    .toarray(), (x + 1).cumsum(axis=0))
+    with pytest.raises(ValueError):
+        b.cumsum(axis=1.5)
