@@ -63,28 +63,6 @@ _JIT_CACHE_MAX = 512
 _SCALAR_FN_CACHE = OrderedDict()
 
 
-def _make_clip(lo, hi, name):
-    def f(v):
-        return jnp.clip(v, lo, hi)
-    # distinct __name__ per parameterisation: _unary's split=0 jit cache
-    # keys on it, and two different clips must not share a program
-    f.__name__ = name
-    return f
-
-
-@lru_cache(maxsize=256)
-def _clip_fn(lo_key, hi_key):
-    # keys carry (type-name, value): lru_cache hashes by equality, and
-    # 0 == 0.0 == False — without the type the first caller's bound TYPE
-    # would leak into later calls and change the result dtype
-    lo = lo_key[1] if lo_key is not None else None
-    hi = hi_key[1] if hi_key is not None else None
-    return _make_clip(lo, hi, "clip_%r_%r" % (lo_key, hi_key))
-
-
-_CLIP_COUNTER = iter(range(1 << 62))
-
-
 @lru_cache(maxsize=256)
 def _round_fn(decimals):
     def f(v):
@@ -635,16 +613,20 @@ class BoltArrayTPU(BoltArray):
         return self._stat(axis, "min", keepdims)
 
     def prod(self, axis=None, keepdims=False):
-        """Product over ``axis`` (default: all key axes) — the ndarray
-        method the local backend inherits, as one compiled program."""
+        """Product over ``axis`` — this backend's mean-family convention
+        (default: all KEY axes, unlike bare ``ndarray.prod()`` which
+        reduces everything; pass ``axis=tuple(range(b.ndim))`` for the
+        full reduction), as one compiled program."""
         return self._stat(axis, "prod", keepdims)
 
     def all(self, axis=None, keepdims=False):
-        """Truth-reduction AND over ``axis`` (ndarray semantics)."""
+        """Truth-reduction AND over ``axis`` (mean-family convention:
+        default reduces the key axes — see :meth:`prod`)."""
         return self._stat(axis, "all", keepdims)
 
     def any(self, axis=None, keepdims=False):
-        """Truth-reduction OR over ``axis`` (ndarray semantics)."""
+        """Truth-reduction OR over ``axis`` (mean-family convention:
+        default reduces the key axes — see :meth:`prod`)."""
         return self._stat(axis, "any", keepdims)
 
     def cumsum(self, axis=None):
@@ -657,15 +639,21 @@ class BoltArrayTPU(BoltArray):
         """Cumulative product (ndarray semantics, see :meth:`cumsum`)."""
         return self._cum("cumprod", axis)
 
-    def _cum(self, name, axis):
+    def _one_axis(self, axis):
+        """Normalise a single-int axis (Integral check, negative wrap,
+        range check) — shared by argmax/argmin/cumsum/cumprod."""
         from numbers import Integral
+        if not isinstance(axis, Integral):
+            raise ValueError("axis %r is not an integer" % (axis,))
+        axis = int(axis)
+        if axis < 0:
+            axis += self.ndim
+        inshape(self.shape, (axis,))
+        return axis
+
+    def _cum(self, name, axis):
         if axis is not None:
-            if not isinstance(axis, Integral):
-                raise ValueError("axis %r is not an integer" % (axis,))
-            axis = int(axis)
-            if axis < 0:
-                axis += self.ndim
-            inshape(self.shape, (axis,))
+            axis = self._one_axis(axis)
         mesh = self._mesh
         split = self._split
         new_split = (1 if split else 0) if axis is None else split
@@ -753,13 +741,7 @@ class BoltArrayTPU(BoltArray):
 
     def _arg_stat(self, name, axis, keepdims):
         if axis is not None:
-            from numbers import Integral
-            if not isinstance(axis, Integral):
-                raise ValueError("axis %r is not an integer" % (axis,))
-            axis = int(axis)
-            if axis < 0:           # numpy semantics: negative axes wrap
-                axis += self.ndim
-            inshape(self.shape, (axis,))
+            axis = self._one_axis(axis)
         mesh = self._mesh
         split = self._split
         if axis is None:
@@ -801,8 +783,13 @@ class BoltArrayTPU(BoltArray):
     def _scalar_fn(self, op, other, reverse):
         """A per-(op, scalar) callable with a STABLE identity, so deferred
         chains built from repeated scalar expressions hit the jit cache
-        instead of recompiling per fresh lambda."""
-        key = (op.__name__, other, reverse)
+        instead of recompiling per fresh lambda.
+
+        The key includes the scalar's TYPE: dict lookup hashes by
+        equality and ``0 == 0.0 == False``, so without it ``b * 2.0``
+        after ``b * 2`` would reuse the int-closing callable and silently
+        change an integer array's result dtype."""
+        key = (op.__name__, type(other).__name__, other, reverse)
         fn = _SCALAR_FN_CACHE.get(key)
         if fn is None:
             if reverse:
@@ -824,8 +811,8 @@ class BoltArrayTPU(BoltArray):
             fn = self._scalar_fn(op, other, reverse)
             if self._split == 0:
                 out = _cached_jit(
-                    ("ew0", opname, other, self.shape, str(self.dtype),
-                     reverse, self._mesh),
+                    ("ew0", opname, type(other).__name__, other, self.shape,
+                     str(self.dtype), reverse, self._mesh),
                     lambda: jax.jit(fn))(self._data)
                 return self._wrap(out, 0)
             return self.map(fn, axis=tuple(range(self._split)))
@@ -899,8 +886,14 @@ class BoltArrayTPU(BoltArray):
     def clip(self, min=None, max=None, a_min=None, a_max=None):
         """Bound values to ``[min, max]`` — the ndarray method (and
         keyword names) the local backend inherits; ``a_min``/``a_max``
-        accepted as np.clip-style aliases.  Defers/fuses like any
-        elementwise op; array-valued bounds broadcast."""
+        accepted as np.clip-style aliases.
+
+        Composed from the elementwise machinery — ``maximum(min)`` then
+        ``minimum(max)``, numpy's ordering (the upper bound wins when
+        ``min > max``) — so scalar bounds defer/fuse through the cached
+        per-scalar callables and array bounds broadcast-validate against
+        the FULL logical shape (key axes included) in one compiled
+        program, exactly like operators."""
         if a_min is not None:
             if min is not None:
                 raise ValueError("pass min= or a_min=, not both")
@@ -911,27 +904,22 @@ class BoltArrayTPU(BoltArray):
             max = a_max
         if min is None and max is None:
             raise ValueError("clip needs at least one of min/max")
-
-        def key(v):
-            if v is None:
-                return None
-            if isinstance(v, (int, float, bool, np.number)):
-                return (type(v).__name__, v)
-            return False  # unhashable/array bound: no caching
-        lo_key, hi_key = key(min), key(max)
-        if lo_key is not False and hi_key is not False:
-            return self._unary(_clip_fn(lo_key, hi_key))
-        # array bounds: a fresh closure with a process-unique name (the
-        # split=0 jit cache keys on __name__, so names must not collide);
-        # recompiles per call, which matches map-with-a-fresh-lambda cost
-        return self._unary(_make_clip(
-            jnp.asarray(min) if min is not None else None,
-            jnp.asarray(max) if max is not None else None,
-            "clip_arr_%d" % next(_CLIP_COUNTER)))
+        out = self
+        if min is not None:
+            out = out._elementwise(min, jnp.maximum)
+        if max is not None:
+            out = out._elementwise(max, jnp.minimum)
+        return out
 
     def round(self, decimals=0):
         """Round to ``decimals`` places (ndarray semantics; banker's
         rounding at .5, identical on both backends)."""
+        from numbers import Integral
+        if not isinstance(decimals, Integral):
+            # ndarray.round raises TypeError here; silent int() truncation
+            # would mask a caller bug only on this backend
+            raise TypeError("decimals must be an integer, got %r"
+                            % (decimals,))
         return self._unary(_round_fn(int(decimals)))
 
     def __lt__(self, other):

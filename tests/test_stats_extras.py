@@ -163,13 +163,26 @@ def test_ndarray_method_parity(mesh):
     ci = bi.clip(0, 9)
     assert ci.dtype == xi.dtype
     assert allclose(ci.toarray(), xi.clip(0, 9))
-    # array-valued bounds broadcast, like ndarray.clip
+    # array-valued bounds broadcast against the FULL logical shape, like
+    # ndarray.clip — including bounds that span the key axes
     lo = np.full(x.shape[2], 0.8)
     assert allclose(b.clip(min=lo).toarray(), x.clip(min=lo))
+    full = np.full(x.shape, 0.9)
+    assert allclose(b.clip(min=full).toarray(), x.clip(min=full))
+    keyed = np.linspace(0.6, 1.1, x.shape[0]).reshape(-1, 1, 1)
+    assert allclose(b.clip(min=keyed).toarray(), x.clip(min=keyed))
+    # min > max: numpy's ordering (the upper bound wins)
+    assert allclose(b.clip(1.0, 0.8).toarray(), x.clip(1.0, 0.8))
     with pytest.raises(ValueError):
         b.clip()
     with pytest.raises(ValueError):
         b.clip(0.1, a_min=0.2)
+    with pytest.raises(TypeError):
+        b.round(1.7)                     # like ndarray.round
+    # scalar-operator cache is type-aware: b*2 then b*2.0 keep dtypes
+    i2 = (bi * 2).toarray()
+    f2 = (bi * 2.0).toarray()
+    assert i2.dtype == xi.dtype and np.issubdtype(f2.dtype, np.floating)
 
 
 def test_cumsum_cumprod_parity(mesh):

@@ -1,0 +1,87 @@
+"""End-to-end analysis workflows chaining the session's ops across both
+backends — the integration surface a Thunder user actually exercises:
+preprocess (detrend/zscore/filters) → reduce (stats/quantile/cov/pca) →
+select (filter/argmax), with local as the oracle at every stage."""
+
+import numpy as np
+import scipy.signal
+
+import bolt_tpu as bolt
+from bolt_tpu.ops import (cov, detrend, gaussian, median_filter, pca,
+                          smooth, zscore)
+from bolt_tpu.utils import allclose
+
+
+def _both(x, mesh):
+    return bolt.array(x), bolt.array(x, mesh, axis=(0,))
+
+
+def test_calcium_imaging_workflow(mesh):
+    # pixels x time with drift + one shared latent oscillation
+    rs = np.random.RandomState(42)
+    npix, T = 64, 48
+    sig = np.sin(np.linspace(0, 4 * np.pi, T))
+    x = (rs.randn(npix, T) * 0.3
+         + np.linspace(0, 2, T)[None, :]
+         + np.outer(rs.randn(npix), sig))
+    lb, tb = _both(x, mesh)
+
+    def pipeline(b):
+        clean = zscore(detrend(b, order=1), epsilon=1e-9)
+        sm = smooth(clean, 3, axis=(0,), size=(12,))
+        return sm
+
+    lclean, tclean = pipeline(lb), pipeline(tb)
+    assert allclose(lclean.toarray(), tclean.toarray(), rtol=1e-6,
+                    atol=1e-8)
+
+    # reductions agree cross-backend and with scipy-built oracles
+    for (l, t) in ((lclean, tclean),):
+        assert allclose(np.asarray(l.stats().mean()),
+                        np.asarray(t.stats().mean()), atol=1e-8)
+        assert allclose(l.quantile(0.9).toarray(),
+                        t.quantile(0.9).toarray(), rtol=1e-6)
+    cl, ct = cov(lclean), cov(tclean)
+    assert allclose(cl, ct, rtol=1e-5, atol=1e-8)
+
+    # PCA on the cleaned data recovers the latent oscillation
+    _, comps_l, sv_l = pca(lclean, k=2)
+    _, comps_t, sv_t = pca(tclean, k=2)
+    assert allclose(sv_l, sv_t, rtol=1e-6)
+    ref = scipy.signal.detrend(x, axis=1)
+    ref = (ref - ref.mean(1, keepdims=True)) / (ref.std(1, keepdims=True)
+                                                + 1e-9)
+    # smoothing preserves the dominant temporal mode's direction
+    c0 = comps_t[:, 0]
+    sm_sig = np.convolve(sig - sig.mean(), np.ones(3) / 3, "same")
+    assert abs(np.dot(c0, sm_sig / np.linalg.norm(sm_sig))) > 0.9
+
+
+def test_image_stack_workflow(mesh2d):
+    # time x H x W stack on a 2-d mesh: denoise spatially, select the
+    # brightest frames, locate each frame's peak pixel
+    rs = np.random.RandomState(7)
+    x = rs.rand(8, 12, 10) ** 2
+    lb = bolt.array(x)
+    tb = bolt.array(x, mesh2d, axis=(0,))
+
+    def denoise(b):
+        return gaussian(median_filter(b, 3, axis=(0, 1), size=(6, 5)),
+                        1.0, axis=(0, 1), size=(6, 5))
+
+    ld, td = denoise(lb), denoise(tb)
+    assert allclose(ld.toarray(), td.toarray(), rtol=1e-6, atol=1e-9)
+
+    means = ld.toarray().reshape(8, -1).mean(axis=1)
+    thresh = float(np.median(means))
+    lf = ld.filter(lambda v: v.mean() > thresh)
+    tf = td.filter(lambda v: v.mean() > thresh)
+    assert lf.shape == tf.shape
+    assert allclose(lf.toarray(), tf.toarray(), rtol=1e-6, atol=1e-9)
+
+    # per-frame peak pixel of the flattened image (argmax over values)
+    lpk = np.asarray([np.argmax(f) for f in lf.toarray()])
+    peak = lambda v: v.reshape(-1).argmax()
+    got = tf.map(peak, axis=(0,)).toarray()
+    assert allclose(np.asarray(got), lpk)
+    assert allclose(np.asarray(lf.map(peak, axis=(0,)).toarray()), lpk)
