@@ -112,6 +112,14 @@ def _op_stacked_map(draw, b, x):
             x - 1.0)
 
 
+def _op_clip(draw, b, x):
+    # round is deliberately NOT fuzzed in chains: it discretises values,
+    # making exact-threshold record means (the filter knife edge) likely
+    lo = draw(st.sampled_from([-1.0, -0.25, 0.0]))
+    hi = draw(st.sampled_from([0.5, 1.5]))
+    return b.clip(lo, hi), x.clip(lo, hi)
+
+
 def _op_normalize(draw, b, x):
     from bolt_tpu.ops import normalize
     if b.ndim - b.split < 1 or x.shape[b.split] < 2:
@@ -149,7 +157,8 @@ def _op_keys_reshape(draw, b, x):
 
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
-        _op_concat_self, _op_keys_reshape, _op_smooth, _op_normalize]
+        _op_concat_self, _op_keys_reshape, _op_smooth, _op_normalize,
+        _op_clip]
 
 
 # ----------------------------------------------------------------------
@@ -221,8 +230,8 @@ def _lop_normalize(draw, b, x):
     return (normalize(b, baseline="mean") + 3.0, (x - mu) / mu + 3.0)
 
 
-# _op_operator/_op_slice0 are backend-agnostic (plain `b + c` / `b[lo:hi]`)
-_LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _lop_filter,
+# _op_operator/_op_slice0/_op_clip are backend-agnostic
+_LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _op_clip, _lop_filter,
               _lop_chunked_map, _lop_stacked_map, _lop_smooth,
               _lop_concat_self, _lop_normalize]
 
