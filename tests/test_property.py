@@ -213,3 +213,19 @@ def test_tsqr_properties(d, seed):
     assert np.allclose(q.T @ q, np.eye(d), atol=1e-12)
     assert np.allclose(q @ r, x, atol=1e-12)
     assert np.allclose(np.tril(r, -1), 0.0, atol=1e-12)
+
+
+@given(array_and_split(), st.data())
+@settings(**SETTINGS)
+def test_order_and_scan_stats_match_numpy(mesh, case, data):
+    x, split = case
+    b = bolt.array(x, mesh, axis=tuple(range(split)))
+    q = data.draw(st.sampled_from([0.0, 0.1, 0.5, 0.75, 1.0]))
+    assert allclose(b.quantile(q).toarray(),
+                    np.quantile(x, q, axis=tuple(range(split))))
+    axis = data.draw(st.integers(-x.ndim, x.ndim - 1))
+    assert allclose(b.argmax(axis=axis).toarray(), np.argmax(x, axis=axis))
+    assert allclose(b.argmin(axis=axis).toarray(), np.argmin(x, axis=axis))
+    assert allclose(b.cumsum(axis=axis).toarray(), x.cumsum(axis=axis))
+    assert allclose(b.median(axis=(x.ndim - 1,)).toarray(),
+                    np.median(x, axis=x.ndim - 1))
