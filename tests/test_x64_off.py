@@ -63,6 +63,20 @@ assert np.allclose(svals, ref, rtol=1e-4)
 u, s_, vh = tallskinny_svd(np.asarray(x64.reshape(384, 4)))
 assert np.asarray(u).dtype == np.float32
 
+# order statistics, covariance and ndarray-parity methods stay f32-clean
+from bolt_tpu.ops import cov
+assert b.median().dtype == np.float32
+assert np.allclose(np.asarray(b.quantile(0.5).toarray()),
+                   np.median(x32, axis=0), atol=1e-6)
+assert np.allclose(cov(b.map(lambda v: v.reshape(24))),
+                   np.cov(x32.reshape(64, 24).astype(np.float64),
+                          rowvar=False), rtol=1e-3, atol=1e-5)
+assert np.array_equal(np.asarray(b.argmax(axis=0).toarray()),
+                      np.argmax(x32, axis=0))
+assert b.clip(-0.5, 0.5).dtype == np.float32
+assert np.allclose(np.asarray(b.cumsum(axis=1).toarray()),
+                   x32.cumsum(axis=1), rtol=1e-5, atol=1e-5)
+
 # halo filters stay f32 and match the f32 local oracle (taps are python
 # floats — weakly typed, no silent f64 promotion on either backend)
 from bolt_tpu.ops import smooth
