@@ -205,3 +205,17 @@ def test_swap_fuses_deferred_chain(mesh):
     b2 = bolt.array(x, mesh).map(lambda v: v + 1)
     s2 = b2.swap((0,), (1,), donate=True)
     assert allclose(s2.toarray(), np.transpose(x + 1, (2, 0, 1)))
+
+
+def test_new_stats_on_pending_filter(mesh):
+    # quantile/argmax/cumsum/clip/prod consume a PENDING (lazy-count)
+    # filter result the same way reduce/sum do
+    x = np.random.RandomState(3).randn(16, 5)
+    b = bolt.array(x, mesh)
+    f = b.filter(lambda v: v.mean() > 0)
+    keep = x[x.mean(axis=1) > 0]
+    assert allclose(f.quantile(0.5).toarray(), np.median(keep, axis=0))
+    assert allclose(f.argmax(axis=0).toarray(), np.argmax(keep, axis=0))
+    assert allclose(f.cumsum(axis=0).toarray(), keep.cumsum(axis=0))
+    assert allclose(f.clip(-0.5, 0.5).toarray(), keep.clip(-0.5, 0.5))
+    assert allclose(f.prod().toarray(), keep.prod(axis=0))
