@@ -76,3 +76,33 @@ def test_key_reduction_lowers_to_all_reduce(mesh):
     assert np.allclose(np.asarray(s.toarray()), x.sum(axis=0))
     txt = _hlo_of_cached("stat", b._data)
     assert "all-reduce" in txt
+
+
+def test_sharded_smooth_lowers_to_neighbour_collective(mesh2d):
+    # sequence-parallel filtering: the value axis is mesh-split, so the
+    # halo each block borrows must ride an inserted neighbour collective
+    # (collective-permute, or all-to-all/all-gather if GSPMD so chooses) —
+    # NOT a host round-trip, and the program must communicate
+    from bolt_tpu.ops import smooth
+    x = np.random.RandomState(5).randn(4, 16, 3)
+    b = bolt.array(x, mesh2d, axis=(0,))
+    out = smooth(b, 5, axis=(0,), size=(4,), shard={0: "b"})
+    oracle = smooth(bolt.array(x), 5, axis=(0,), size=(4,))
+    assert np.allclose(out.toarray(), oracle.toarray())
+    txt = _hlo_of_cached("chunk-map-g", b._data)
+    assert ("collective-permute" in txt or "all-to-all" in txt
+            or "all-gather" in txt), "no inter-device halo communication"
+
+
+def test_quantile_lowers_to_sorted_collective_program(mesh):
+    # a key-axis quantile over the sharded axis must sort on device and
+    # combine across shards (GSPMD inserts the gather/reduce it needs)
+    x = np.random.RandomState(6).randn(16, 6)
+    b = bolt.array(x, mesh)
+    out = b.quantile(0.5)
+    assert np.allclose(out.toarray(), np.median(x, axis=0))
+    from bolt_tpu.tpu import array as array_mod
+    fns = [v for k, v in array_mod._JIT_CACHE.items() if k[0] == "quantile"]
+    assert fns
+    txt = fns[-1].lower(b._data, 0.5).compile().as_text()  # q is an ARG
+    assert "sort" in txt
