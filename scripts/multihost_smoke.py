@@ -63,6 +63,11 @@ def worker(pid):
     st = b.stats()
     assert np.allclose(np.asarray(st.mean()), x.mean(axis=0))
 
+    # order statistics over the cross-process key axis: the device-side
+    # sort/gather spans the (simulated) DCN
+    md = m.median()
+    assert np.allclose(md.toarray(), np.median(x * 2 + 1, axis=0))
+
     s = b.swap((0,), (1,))
     assert s.shape == (4, nkeys, 6)
 
