@@ -253,3 +253,24 @@ def test_normalize_parity(mesh):
         normalize(bolt.array(x), baseline="windowed")
     with pytest.raises(ValueError):
         normalize(bolt.array(x), perc=150)
+
+
+def test_series_transforms_differentiable():
+    # the block functions are pure jnp pipelines: grads flow through them
+    # for users embedding these transforms in larger differentiable models
+    import jax
+    import jax.numpy as jnp
+    from bolt_tpu.ops.series import _detrend_fn, _zscore_fn
+
+    x = jnp.asarray(np.random.RandomState(2).randn(20))
+    det = _detrend_fn(20, 1, 0)
+    g = jax.grad(lambda v: jnp.sum(det(v) ** 2))(x)
+    # analytic: d/dv ||R v||^2 = 2 R^T R v = 2 R v (projector: R^T R = R)
+    t = np.linspace(-1, 1, 20)
+    a = np.vander(t, 2, increasing=True)
+    r = np.eye(20) - a @ np.linalg.pinv(a)
+    assert np.allclose(np.asarray(g), 2 * r @ np.asarray(x), atol=1e-10)
+
+    zs = _zscore_fn(0, 0, 1e-9)
+    gz = jax.grad(lambda v: jnp.sum(zs(v) ** 2))(x)
+    assert np.isfinite(np.asarray(gz)).all()
