@@ -17,14 +17,14 @@ __version__ = "0.1.0"
 
 from bolt_tpu.factory import (array, concatenate, fromcallback, ones, rand,
                               randn, zeros)
-from bolt_tpu.base import BoltArray
+from bolt_tpu.base import BoltArray, HostFallbackWarning
 from bolt_tpu.local.array import BoltArrayLocal
 from bolt_tpu.tpu.array import BoltArrayTPU
 from bolt_tpu.utils import allclose
 
 __all__ = ["array", "ones", "zeros", "rand", "randn", "fromcallback",
            "concatenate", "allclose", "BoltArray", "BoltArrayLocal",
-           "BoltArrayTPU", "__version__"]
+           "BoltArrayTPU", "HostFallbackWarning", "__version__"]
 
 _SUBMODULES = ("checkpoint", "profile", "parallel", "ops", "statcounter",
                "utils")

@@ -9,6 +9,16 @@ SURVEY.md §0.
 from abc import ABCMeta, abstractmethod
 
 
+class HostFallbackWarning(UserWarning):
+    """A ``mode='tpu'`` functional op received a non-jax-traceable callable
+    and is rerouting through the local (NumPy) oracle — a full
+    device→host→device round-trip.  Semantics are preserved but throughput
+    drops by orders of magnitude on real hardware; rewrite the callable with
+    the jax-compatible numpy-API subset to stay on device (SURVEY §7 hard
+    part 4's documented escape hatch).  Filter or ``error`` this category to
+    locate (or forbid) fallback sites."""
+
+
 class BoltArray(metaclass=ABCMeta):
     """An n-dimensional array whose axes split into *key axes* (the
     distributed / parallel domain) and *value axes* (the local block each

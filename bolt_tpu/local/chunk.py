@@ -37,8 +37,9 @@ class LocalChunkedArray:
         data = np.asarray(data)
         vshape = data.shape[split:]
         axes, size, padding = chunk_align(vshape, axis, size, padding)
-        plan = chunk_plan(vshape, data.dtype.itemsize, size, axes)
-        pad = chunk_pad(plan, axes, padding, len(vshape))
+        plan = chunk_plan(vshape, data.dtype.itemsize, size, axes,
+                          padding=padding)
+        pad = chunk_pad(plan, axes, padding, vshape)
         return cls(data, split, plan, pad)
 
     # ------------------------------------------------------------------
@@ -144,8 +145,10 @@ class LocalChunkedArray:
             # zero records: the empty result must still carry the value
             # shape func WOULD produce, inferred by running it on a zeros
             # probe (the TPU path uses eval_shape; this backend executes
-            # func for real)
-            probe = one_record(np.zeros(vshape, self._data.dtype))
+            # func for real — silence the numeric warnings an all-zeros
+            # block can trigger in funcs that divide/log their input)
+            with np.errstate(all="ignore"):
+                probe = one_record(np.zeros(vshape, self._data.dtype))
             out = np.zeros((0,) + probe.shape, probe.dtype)
         check_value_shape(value_shape, tuple(
             o // g for o, g in zip(out.shape[1:], grid)) if shape_change_ok

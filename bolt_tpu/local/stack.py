@@ -70,8 +70,10 @@ class LocalStackedArray:
             out = np.concatenate(outs, axis=0)
         else:
             # zero records: infer the output value shape func WOULD produce
-            probe = np.asarray(func(np.zeros((self._size,) + vshape,
-                                             self._data.dtype)))
+            # (warnings silenced — an all-zeros probe block may divide/log)
+            with np.errstate(all="ignore"):
+                probe = np.asarray(func(np.zeros((self._size,) + vshape,
+                                                 self._data.dtype)))
             out = np.zeros((0,) + probe.shape[1:], probe.dtype)
         check_value_shape(value_shape, tuple(out.shape[1:]))
         if dtype is not None:

@@ -25,8 +25,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from bolt_tpu.parallel.sharding import combined_spec
-from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _canon,
-                                _chain_apply, _check_live,
+from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
+                                _canon, _chain_apply, _check_live,
                                 _check_value_shape, _constrain, _traceable)
 from bolt_tpu.utils import (chunk_align, chunk_pad, chunk_plan, iterexpand,
                             tupleize)
@@ -105,8 +105,9 @@ class ChunkedArray:
         split = barray.split
         vshape = barray.shape[split:]
         axes, size, padding = chunk_align(vshape, axis, size, padding)
-        plan = chunk_plan(vshape, barray.dtype.itemsize, size, axes)
-        pad = chunk_pad(plan, axes, padding, len(vshape))
+        plan = chunk_plan(vshape, barray.dtype.itemsize, size, axes,
+                          padding=padding)
+        pad = chunk_pad(plan, axes, padding, vshape)
         return cls(barray, plan, pad)
 
     # ------------------------------------------------------------------
@@ -211,7 +212,9 @@ class ChunkedArray:
             try:
                 hint_ob = jax.eval_shape(func, jax.ShapeDtypeStruct(
                     tuple(self._plan), self._barray._aval.dtype))
-            except Exception:
+            except _TRACE_ERRORS:
+                # non-traceable func: skip hint validation (errors surface
+                # at the real trace)
                 hint_ob = None
             _check_value_shape(
                 value_shape, None if hint_ob is None else tuple(hint_ob.shape))
@@ -244,7 +247,7 @@ class ChunkedArray:
                         else tuple(jax.eval_shape(
                             func, jax.ShapeDtypeStruct(
                                 tuple(plan), b._aval.dtype)).shape)
-                except Exception:
+                except _TRACE_ERRORS:
                     ob_shape = None
                 if ob_shape is not None and len(ob_shape) == nv:
                     out_full = kshape + tuple(

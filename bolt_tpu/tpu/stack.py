@@ -16,8 +16,8 @@ blocks in one compiled program.
 import jax
 import jax.numpy as jnp
 
-from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _canon,
-                                _chain_apply, _check_live,
+from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
+                                _canon, _chain_apply, _check_live,
                                 _check_value_shape, _constrain, _traceable)
 from bolt_tpu.utils import prod
 
@@ -85,7 +85,9 @@ class StackedArray:
             try:
                 ob = jax.eval_shape(func, jax.ShapeDtypeStruct(
                     (min(size, n) or size,) + vshape, b._aval.dtype))
-            except Exception:
+            except _TRACE_ERRORS:
+                # non-traceable func: skip hint validation (shape errors
+                # would still surface at the real trace below)
                 ob = None
             _check_value_shape(
                 value_shape, None if ob is None else tuple(ob.shape[1:]))

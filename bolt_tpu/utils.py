@@ -110,12 +110,57 @@ def slicify(slc, dim):
                 raise IndexError(
                     "boolean index of shape %s does not match axis of size %d" % (arr.shape, dim))
             return np.nonzero(arr)[0]
+        if arr.ndim != 1:
+            # the per-axis orthogonal take contract is 1-d index lists
+            # (like the bool branch above); a multi-d take would silently
+            # shift every later axis
+            raise IndexError(
+                "per-axis advanced index must be 1-d, got shape %s"
+                % (arr.shape,))
         arr = arr.astype(np.int64)
         arr = np.where(arr < 0, arr + dim, arr)
         if arr.size and (arr.min() < 0 or arr.max() >= dim):
             raise IndexError("index out of bounds for axis of size %d" % dim)
         return arr
     raise ValueError("cannot index axis with %r" % (slc,))
+
+
+def normalize_index(index, shape):
+    """Normalise a full ``__getitem__`` index against ``shape`` to
+    ``(norm, squeezed)``: one entry per axis, each a canonical ``slice`` or
+    a 1-d integer ``np.ndarray`` (advanced), with ``squeezed`` listing the
+    axes indexed by scalars (to drop from the result).  Expands a single
+    ``Ellipsis``, pads missing axes with full slices, and treats 0-d
+    integer arrays (e.g. ``np.argmax`` results) as scalars so a per-axis
+    ``take`` never silently shifts later axes.
+
+    Shared by BOTH backends' multiple-advanced-index paths — one
+    normalisation, one semantics (reference: the ``_getbasic``/
+    ``_getadvanced`` split in ``bolt/spark/array.py``).
+    """
+    idx = index if isinstance(index, tuple) else (index,)
+    ndim = len(shape)
+    ell = [n for n, i in enumerate(idx) if i is Ellipsis]
+    if len(ell) > 1:
+        raise IndexError("an index can only have a single ellipsis ('...')")
+    if ell:
+        pos = ell[0]
+        fill = ndim - (len(idx) - 1)
+        if fill < 0:
+            raise ValueError("too many indices for %d-d array" % ndim)
+        idx = idx[:pos] + (slice(None),) * fill + idx[pos + 1:]
+    if len(idx) > ndim:
+        raise ValueError("too many indices for %d-d array" % ndim)
+    idx = idx + (slice(None),) * (ndim - len(idx))
+    squeezed = []
+    norm = []
+    for ax, (i, dim) in enumerate(zip(idx, shape)):
+        if isinstance(i, np.ndarray) and i.ndim == 0 and i.dtype != bool:
+            i = int(i)
+        if isinstance(i, (int, np.integer)):
+            squeezed.append(ax)
+        norm.append(slicify(i, dim))
+    return norm, squeezed
 
 
 def istransposeable(new, old):
@@ -227,19 +272,29 @@ def check_value_shape(hint, inferred):
                          % (tuple(tupleize(hint)), tuple(inferred)))
 
 
-def chunk_plan(vshape, itemsize, size, axes):
+def chunk_plan(vshape, itemsize, size, axes, padding=None):
     """Per-value-axis chunk sizes.  A string ``size`` is a per-block
     megabyte budget (the reference's ``size='150'`` default) — the largest
     chunkable axis is halved until the block fits; an int/tuple gives
     explicit chunk sizes for ``axes`` (reference:
-    ``bolt/spark/chunk.py :: ChunkedArray._chunk`` plan computation)."""
+    ``bolt/spark/chunk.py :: ChunkedArray._chunk`` plan computation).
+
+    ``padding`` (the halo widths, paired with ``axes``) floors the budget
+    halving at ``halo + 1`` per axis, so a wide filter under a tight
+    budget gets a slightly-over-budget plan instead of an invalid one
+    whose halo exceeds its chunk; explicit int sizes are the user's exact
+    request and stay strictly validated downstream."""
     plan = list(vshape)
+    floor = [1] * len(vshape)
+    if padding is not None:
+        for a, p in zip(axes, iterexpand(padding, len(axes))):
+            floor[a] = min(int(p) + 1, vshape[a])
     if isinstance(size, str):
         budget = float(size) * 1e6
         while (prod(plan) * itemsize > budget
-               and any(plan[a] > 1 for a in axes)):
-            a = max(axes, key=lambda i: plan[i])
-            plan[a] = -(-plan[a] // 2)
+               and any(plan[a] > floor[a] for a in axes)):
+            a = max(axes, key=lambda i: plan[i] - floor[i])
+            plan[a] = max(-(-plan[a] // 2), floor[a])
     else:
         sizes = iterexpand(size, len(axes))
         for a, s in zip(axes, sizes):
@@ -249,16 +304,23 @@ def chunk_plan(vshape, itemsize, size, axes):
     return plan
 
 
-def chunk_pad(plan, axes, padding, nv):
+def chunk_pad(plan, axes, padding, vshape):
     """Per-value-axis halo widths; a halo must be smaller than its chunk
-    (reference: ``ChunkedArray._chunk`` padding validation)."""
+    (reference: ``ChunkedArray._chunk`` padding validation) — except on an
+    UNCHUNKED axis (one block spanning the whole axis), where the halo
+    only ever clips at the array edges and any width is harmless (a wider-
+    than-axis filter radius must still run)."""
+    nv = len(vshape)
     pad = [0] * nv
     if padding is not None:
         pads = iterexpand(padding, len(axes))
         for a, p in zip(axes, pads):
-            if p < 0 or (p > 0 and p >= plan[a]):
+            if p < 0 or (p >= plan[a] > 0 and plan[a] < vshape[a]):
                 raise ValueError(
                     "padding %d must be smaller than the chunk size %d "
-                    "on axis %d" % (p, plan[a], a))
+                    "on axis %d — a halo (e.g. a filter's width/sigma "
+                    "radius) cannot exceed its block; pass a larger "
+                    "size= (chunk budget or explicit per-axis sizes)"
+                    % (p, plan[a], a))
             pad[a] = int(p)
     return pad

@@ -48,9 +48,9 @@ def test_swap_nondivisible_still_avoids_full_gather(mesh):
 def test_welford_stats_lowers_to_all_reduce(mesh):
     x = np.random.RandomState(2).randn(16, 4, 6)
     b = bolt.array(x, mesh)
-    b.stats()  # populates the welford program cache
-    from bolt_tpu.tpu import stats as stats_mod
-    fns = [v for k, v in stats_mod._WELFORD_CACHE.items() if k[0] == "welford"]
+    b.stats()  # populates the shared executable cache
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    fns = [v for k, v in _JIT_CACHE.items() if k[0] == "welford"]
     assert fns
     txt = fns[-1].lower(b._data).compile().as_text()
     assert "all-reduce" in txt          # psum/pmax/pmin over the mesh axis

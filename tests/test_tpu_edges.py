@@ -112,3 +112,55 @@ def test_pipeline_under_disable_jit(mesh):
         f = b.filter(lambda v: v.mean() > 0)
         assert np.allclose(f.toarray(), x[x.mean(axis=1) > 0])
         assert np.allclose(b.swap((0,), (0,)).toarray(), x.T)
+
+
+# ----------------------------------------------------------------------
+# round-2 ADVICE fixes
+# ----------------------------------------------------------------------
+
+def test_one_axis_typeerror_matches_ndarray(mesh):
+    # non-integral axis raises TypeError on BOTH backends (ndarray's type)
+    import bolt_tpu as bolt
+    x = np.random.RandomState(0).randn(4, 6)
+    tp = bolt.array(x, mesh)
+    with pytest.raises(TypeError):
+        tp.cumsum(axis=1.5)
+    with pytest.raises(TypeError):
+        tp.argmax(axis=(0, 1))
+    with pytest.raises(TypeError):
+        bolt.array(x).cumsum(axis=1.5)   # ndarray raises TypeError too
+
+
+def test_wide_filter_tight_budget(mesh):
+    # a halo wider than the budget-halved chunk plan used to surface as an
+    # opaque "padding must be smaller than the chunk size"; the plan is
+    # now floored at halo+1 and the filter just runs
+    import bolt_tpu as bolt
+    from bolt_tpu.ops import gaussian
+    x = np.random.RandomState(1).randn(2, 256).astype(np.float64)
+    b = bolt.array(x, mesh)
+    out = gaussian(b, sigma=8.0, axis=0, size="0.001")   # ~1 kB budget
+    lo = gaussian(bolt.array(x), sigma=8.0, axis=0, size="0.001")
+    assert bolt.allclose(out.toarray(), lo.toarray())
+
+
+def test_explicit_small_chunk_vs_halo_names_fix(mesh):
+    # explicit per-axis sizes are the user's exact request: still an
+    # error, but one that tells them what to change
+    import bolt_tpu as bolt
+    b = bolt.array(np.random.RandomState(2).randn(2, 64), mesh)
+    with pytest.raises(ValueError, match="size="):
+        b.chunk(size=4, axis=0, padding=10)
+
+
+def test_zero_record_local_chunk_probe_no_warn():
+    # the zeros probe for empty chunked/stacked maps must not leak numeric
+    # warnings from funcs that divide by their input
+    import warnings
+    import bolt_tpu as bolt
+    lo = bolt.array(np.zeros((0, 8)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = lo.chunk(size=4, axis=0, key_axis=(0,)).map(
+            lambda blk: blk / blk).unchunk()
+    assert out.shape == (0, 8)

@@ -72,10 +72,36 @@ def test_welford_partial_axis(mesh):
     assert allclose(counter.variance(), x.var(axis=1))
 
 
-def test_welford_rejects_value_axis(mesh):
-    b = bolt.array(_x(), mesh)
+def test_welford_value_axis(mesh):
+    # stats() accepts value axes, matching mean()/_stat (VERDICT r1 weak-6)
+    x = _x()
+    b = bolt.array(x, mesh)
+    counter = b.stats(axis=(1,))
+    assert counter.count() == x.shape[1]
+    assert allclose(counter.mean(), x.mean(axis=1))
+    assert allclose(counter.variance(), x.var(axis=1))
+    assert allclose(counter.max(), x.max(axis=1))
+    # mixed key + value axes
+    counter = b.stats(axis=(0, 2))
+    assert allclose(counter.mean(), x.mean(axis=(0, 2)))
+    assert allclose(counter.variance(), x.var(axis=(0, 2)))
+    # parity with the local oracle per axis set
+    lo = bolt.array(x)
+    for axes in [(0,), (1,), (2,), (0, 1), (0, 2), (1, 2)]:
+        a = lo.stats(axis=axes)
+        t = b.stats(axis=axes)
+        assert allclose(a.mean(), t.mean())
+        assert allclose(a.variance(), t.variance())
+    # out-of-range still rejected
     with pytest.raises(ValueError):
-        b.stats(axis=(1,))
+        b.stats(axis=(9,))
+
+
+def test_welford_cache_bounded(mesh):
+    # the welford executable cache is the shared bounded LRU, not an
+    # unbounded private dict
+    import bolt_tpu.tpu.stats as stats_mod
+    assert not hasattr(stats_mod, "_WELFORD_CACHE")
 
 
 def test_sum_bit_exact_integral(mesh):
