@@ -71,8 +71,50 @@ def worker(pid):
     s = b.swap((0,), (1,))
     assert s.shape == (4, nkeys, 6)
 
-    full = m.toarray()  # cross-host allgather path
+    full = m.toarray()  # cross-host gather path
     assert np.allclose(full, x * 2 + 1)
+
+    # memory-bounded cross-host collect: force the slab path and assert
+    # no single device-side transfer carried the whole array (the VERDICT
+    # r1 scenario was process_allgather replicating a 1 TB array on every
+    # host; here shard-bytes accounting stands in for an RSS cap)
+    from bolt_tpu.tpu import array as _arr
+    big_np = np.arange(nkeys * 16, dtype=np.float64).reshape(nkeys, 16)
+    big = bolt.array(big_np, mesh)
+    if NPROC > 1:
+        assert not big._data.is_fully_addressable
+        # byte math on the DEVICE dtype (x64-off narrows f64 -> f32)
+        nbytes = big.size * big.dtype.itemsize
+        rowbytes = nbytes // big.shape[0]
+        old = _arr._GATHER_SLAB_BYTES
+        _arr._GATHER_SLAB_BYTES = rowbytes      # force region splitting
+        try:
+            got = big.toarray()
+        finally:
+            _arr._GATHER_SLAB_BYTES = old
+        assert np.array_equal(got, big_np)
+        st = _arr._LAST_GATHER_STATS
+        # remote regions were broadcast in sub-region pieces, every piece
+        # within the budget — no transfer ever approached the full array
+        assert st["regions"] >= NPROC - 1, st
+        assert st["broadcasts"] > st["regions"], st
+        assert 0 < st["max_piece_bytes"] <= rowbytes, (st, rowbytes)
+
+    # checkpoint written from mesh A (every process saves only the shards
+    # it owns), restored onto mesh B with a different topology
+    from bolt_tpu import checkpoint
+    ckpt_dir = os.environ["SMOKE_CKPT"]
+    checkpoint.save(ckpt_dir, m.cache())
+    if ndev % 2 == 0 and ndev > 1:
+        mesh_b = make_mesh((2, ndev // 2), ("p", "q"))
+        restored = checkpoint.load(ckpt_dir, context=mesh_b)
+        assert restored.split == m.split
+        assert restored.mesh is not mesh and restored.shape == m.shape
+        assert np.allclose(restored.toarray(), x * 2 + 1)
+        # the restored array is live on the new mesh, not just readable
+        assert np.allclose(restored.sum().toarray(), (x * 2 + 1).sum(axis=0))
+    # (tempdir cleanup lives in main()'s finally, so failed/timed-out
+    # runs don't leak checkpoint dirs in /tmp)
 
     # the sharded loader: each PROCESS's callback must be invoked only
     # for its own devices' shards — the full array is never assembled in
@@ -121,8 +163,10 @@ def worker(pid):
 
 
 def main():
+    import tempfile
     env = dict(os.environ)
     env["SMOKE_PORT"] = str(_free_port())  # never collide with a stale run
+    env["SMOKE_CKPT"] = tempfile.mkdtemp(prefix="bolt_smoke_ckpt_")
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--worker", str(pid)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
@@ -146,6 +190,8 @@ def main():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        import shutil
+        shutil.rmtree(env["SMOKE_CKPT"], ignore_errors=True)
     print("multihost smoke:", "PASS" if ok else "FAIL")
     sys.exit(0 if ok else 1)
 
