@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Per-op-family perf-regression harness (round 2, VERDICT r1 next-2).
+
+Measures steady-state device throughput for each core op family at
+device-dominated sizes (every config ≥ ~0.9 GB, so the ~3 ms dispatch
+floor of this environment's remote attach is <10% of any timing), prints
+one JSON line per family, writes ``PERF.json``, and — when a committed
+``PERF_BASELINE.json`` exists — reports any family slower than baseline
+by more than ``THRESHOLD`` (exit code 2, so CI can warn without
+conflating regressions with failures).
+
+Usage::
+
+    python scripts/perf_regress.py              # measure + compare
+    python scripts/perf_regress.py --rebaseline # overwrite the baseline
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import bolt_tpu as bolt  # noqa: E402
+
+THRESHOLD = 0.25   # fractional slowdown vs baseline that counts as a regression
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "PERF.json")
+BASE = os.path.join(ROOT, "PERF_BASELINE.json")
+
+
+# ONE timing harness: bench_all's pipelined steady-state methodology
+# (closing-probe round-trip measured and subtracted; keep_all=False frees
+# the warm result and in-flight handles for multi-GB outputs)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench_all import timed_tpu  # noqa: E402
+
+
+def steady(launch, iters=6, keep_all=True):
+    _, sec = timed_tpu(launch, iters=iters, keep_all=keep_all)
+    return sec
+
+
+# Every family generates its data ON DEVICE (bolt.randn/ones): shipping a
+# 2 GB host array through this environment's ~17 MB/s attach tunnel would
+# take ~2 minutes and measure the tunnel.  ``bytes`` is the logical input
+# size — the GB/s figures are per-pass-over-the-input throughput,
+# comparable across rounds, not absolute HBM traffic.
+
+MAPSUM_FN = lambda v: v + 1
+FILTER_PRED = lambda v: v.mean() > 0
+
+
+def fam_map_sum():
+    shape = (8192, 256, 256)                      # 2.1 GB f32
+    b = bolt.ones(shape, mode="tpu", dtype=np.float32).cache()
+    return int(np.prod(shape)) * 4, steady(
+        lambda: b.map(MAPSUM_FN).sum(axis=(0, 1, 2)))
+
+
+def fam_stats_welford():
+    # the shard_map Welford (pallas fused_welford engages — 128-aligned
+    # minor dim); times the compiled program via the executable cache,
+    # with the same probe-roundtrip subtraction as every other family
+    # (folding the ~65 ms tunnel sync into /iters would mostly measure
+    # the attach link)
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    shape = (8192, 256, 256)
+    nbytes = int(np.prod(shape)) * 4
+    b = bolt.ones(shape, mode="tpu", dtype=np.float32).cache()
+    b.stats()
+    prog = next(v for k, v in _JIT_CACHE.items() if k[0] == "welford")
+    data = b._data
+    probe = jax.jit(lambda t: t[0].ravel()[0])
+    warm = prog(data)
+    jax.device_get(probe(warm))
+    rts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(probe(warm))
+        rts.append(time.perf_counter() - t0)
+    rt = min(rts)
+    iters = 6
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = prog(data)
+    jax.device_get(probe(r))
+    return nbytes, (time.perf_counter() - t0 - rt) / iters
+
+
+def fam_swap():
+    shape = (1024, 128, 64, 64)                   # 2.1 GB
+    b = bolt.randn(shape, mode="tpu", axis=(0, 1), seed=3,
+                   dtype=np.float32).cache()
+    return int(np.prod(shape)) * 4, steady(
+        lambda: b.swap((0,), (0,)), iters=5, keep_all=False)
+
+
+def fam_filter_fused():
+    shape = (14336, 256, 64)                      # 0.94 GB
+    b = bolt.randn(shape, mode="tpu", seed=4, dtype=np.float32).cache()
+    return int(np.prod(shape)) * 4, steady(
+        lambda: b.filter(FILTER_PRED), iters=5)
+
+
+def fam_matmul():
+    # the MXU path (highest precision, numpy-parity default); the weight
+    # is device-resident — a host ndarray operand would re-upload per call
+    n = 8192                                      # 0.8 GB of operands
+    w = bolt.randn((n, n), mode="tpu", seed=8, dtype=np.float32).tojax()
+    b = bolt.randn((n, n), mode="tpu", seed=7, dtype=np.float32).cache()
+    return 2 * n * n * 4, steady(
+        lambda: b @ w, iters=5, keep_all=False)
+
+
+def fam_halo_gaussian():
+    from bolt_tpu.ops import gaussian
+    shape = (64, 2048, 4096)                      # 2.1 GB
+    b = bolt.randn(shape, mode="tpu", seed=6, dtype=np.float32).cache()
+    return int(np.prod(shape)) * 4, steady(
+        lambda: gaussian(b, sigma=2.0, axis=(0, 1), size="64"),
+        iters=4, keep_all=False)
+
+
+def fam_pca():
+    from bolt_tpu.ops import pca
+    b = bolt.randn((33554432, 16), mode="tpu", seed=5).cache()  # 2.1 GB
+
+    def run_pca():
+        scores, comps, svals = pca(b, k=4, center=True)
+        return scores
+    return 33554432 * 16 * 4, steady(run_pca, iters=3, keep_all=False)
+
+
+FAMILIES = [
+    ("map_sum", fam_map_sum),
+    ("stats_welford", fam_stats_welford),
+    ("swap", fam_swap),
+    ("filter_fused", fam_filter_fused),
+    ("matmul", fam_matmul),
+    ("halo_gaussian", fam_halo_gaussian),
+    ("pca", fam_pca),
+]
+
+
+def main():
+    rebase = "--rebaseline" in sys.argv
+    only = None
+    for arg in sys.argv[1:]:
+        if arg.startswith("--only="):
+            only = set(arg.split("=", 1)[1].split(","))
+    # start from the committed baseline plus any previous partial
+    # measurement (fresher wins), so a run cut short by a wall-clock
+    # budget (remote-attach variance is 2-10x) resumes instead of losing
+    # everything, and `--rebaseline --only=fam` never wipes the other
+    # families' baselines; results are flushed after EVERY family
+    results = {}
+    for path in (BASE, OUT):
+        if os.path.exists(path):
+            with open(path) as f:
+                results.update(json.load(f))
+    failed = []
+    for name, fam in FAMILIES:
+        if only is not None and name not in only:
+            continue
+        try:
+            nbytes, sec = fam()
+        except Exception as e:   # one broken family must not lose the rest
+            print("family %s FAILED: %s" % (name, e), file=sys.stderr)
+            failed.append(name)
+            # purge any stale number: a broken family must not regression-
+            # gate on data from a previous run
+            results.pop(name, None)
+            continue
+        gbps = nbytes / sec / 1e9
+        results[name] = {"s_per_iter": round(sec, 5), "bytes": nbytes,
+                         "gbps": round(gbps, 1)}
+        print(json.dumps({"family": name, **results[name]}), flush=True)
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1, sort_keys=True)
+
+    if rebase or not os.path.exists(BASE):
+        with open(BASE, "w") as f:
+            json.dump(results, f, indent=1, sort_keys=True)
+        print("baseline written to", BASE, file=sys.stderr)
+        return 2 if failed else 0
+
+    with open(BASE) as f:
+        base = json.load(f)
+    regressed = []
+    for name, r in results.items():
+        b = base.get(name)
+        if b and r["gbps"] < b["gbps"] * (1 - THRESHOLD):
+            regressed.append((name, b["gbps"], r["gbps"]))
+    for name, was, now in regressed:
+        print("REGRESSION %s: %.1f -> %.1f GB/s" % (name, was, now),
+              file=sys.stderr)
+    bad = bool(regressed or failed)
+    print("perf_regress:", "FAIL" if bad else "OK", file=sys.stderr)
+    return 2 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -266,3 +266,46 @@ def test_mesh_mismatch_raises(mesh):
     # explicit move works
     out = b + c.tolocal().totpu(context=mesh)
     assert bolt.allclose(out.toarray(), x * 2)
+
+
+def test_jax_array_operands_no_host_roundtrip(mesh):
+    # a jax.Array operand must feed the compiled op directly — routing it
+    # through np.asarray would fetch it to host and re-upload on EVERY
+    # call (measured 12 s/call for a 0.27 GB weight through a remote
+    # attach). np.asarray on a non-fully-addressable array would also
+    # simply crash, so this path is correctness too, not just speed.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np_mod
+    x = _x()
+    b = bolt.array(x, mesh)
+    w = jnp.asarray(np_mod.ones(x.shape[1:], np_mod.float32))
+    orig = np_mod.asarray
+    seen = []
+    def spy(a, *args, **kw):
+        if isinstance(a, jax.Array):
+            seen.append(type(a))
+        return orig(a, *args, **kw)
+    np_mod.asarray = spy
+    try:
+        out1 = (b + w).toarray()
+        wj = jnp.asarray(np_mod.ones((5, 3), np_mod.float32))
+        out2 = (b @ wj).toarray()
+        b.concatenate(jnp.asarray(x.astype(np_mod.float32)))
+    finally:
+        np_mod.asarray = orig
+    assert not seen, "jax operand was bounced through np.asarray"
+    assert allclose(out1, x + 1)
+    assert allclose(out2, x @ np_mod.ones((5, 3)))
+
+
+def test_foreign_device_operand_falls_back(mesh):
+    # a jax.Array committed OUTSIDE the mesh's devices must take the host
+    # coercion path (feeding it to the mesh-sharded jit would raise
+    # "incompatible devices"), preserving pre-round-2 behavior
+    import jax
+    x = _x()
+    half = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("k",))
+    b = bolt.array(x, half)
+    w = jax.device_put(np.ones(x.shape), jax.devices()[6])
+    assert allclose((b + w).toarray(), x + 1)
