@@ -19,6 +19,7 @@ numbers are meaningful.  This module packages that:
   practical hazard, so that's what debug mode checks).
 """
 
+import contextlib
 import time
 
 import numpy as np
@@ -73,6 +74,85 @@ def array_bytes(barray):
 def debug_nans(enable=True):
     """Toggle jax's NaN checking for all subsequently compiled programs."""
     jax.config.update("jax_debug_nans", bool(enable))
+
+
+@contextlib.contextmanager
+def instrument():
+    """Context manager recording per-op-family execution counts, compile
+    (executable-build) counts and host dispatch time for every bolt
+    operation run inside it::
+
+        with bolt_tpu.profile.instrument() as stats:
+            b.map(f).sum().toarray()
+            b.stats()
+        print(bolt_tpu.profile.report(stats))
+
+    ``stats`` maps op family — the executable-cache key prefix:
+    ``"chain"`` (materialising a deferred map chain), ``"map-wk"``,
+    ``"reduce"``, ``"stat"`` (mean/sum/... family), ``"welford"``,
+    ``"filter-fused"``, ``"swap"``, ``"getitem"``, ... — to
+    ``{"calls", "builds", "dispatch_s"}``.  ``builds`` counts jit-cache
+    misses — the RECOMPILE detector: a pipeline that rebuilds the same
+    family every iteration (e.g. a fresh lambda per call) shows
+    ``builds == calls`` instead of ``builds == 1``.  ``dispatch_s`` is
+    host-side dispatch (launches are async); use :func:`timeit` or
+    :func:`trace` for device-completion timing.
+
+    The reference has nothing comparable in-repo (Spark UI fills the
+    slot, SURVEY §5); this is the framework-level half of that story.
+    """
+    import bolt_tpu.tpu.array as _arr
+    import bolt_tpu.tpu.chunk as _chunk
+    import bolt_tpu.tpu.stack as _stack
+    import bolt_tpu.tpu.stats as _stats
+    # every module binds _cached_jit by name at import; snapshot and
+    # restore EACH binding so nested/overlapping contexts unwind cleanly
+    saved = {m: m._cached_jit for m in (_arr, _chunk, _stack, _stats)}
+    orig = _arr._cached_jit
+    stats = {}
+
+    def wrapped(key, builder):
+        fam = key[0] if isinstance(key, tuple) and key else str(key)
+        e = stats.setdefault(
+            fam, {"calls": 0, "builds": 0, "dispatch_s": 0.0})
+
+        def counting_builder():
+            e["builds"] += 1
+            return builder()
+
+        fn = orig(key, counting_builder)
+
+        def timed(*a, **k):
+            t0 = time.perf_counter()
+            out = fn(*a, **k)
+            e["calls"] += 1
+            e["dispatch_s"] += time.perf_counter() - t0
+            return out
+        return timed
+
+    for m in saved:
+        m._cached_jit = wrapped
+    try:
+        yield stats
+    finally:
+        for m, fn in saved.items():
+            # restore only our own wrapper: if an inner instrument() is
+            # still live (contexts should exit LIFO, but generators /
+            # ExitStacks can misorder), leave its wrapper counting
+            # rather than silently disabling it
+            if m._cached_jit is wrapped:
+                m._cached_jit = fn
+
+
+def report(stats):
+    """Human-readable table for :func:`instrument` results."""
+    lines = ["%-16s %7s %7s %12s" % ("family", "calls", "builds",
+                                     "dispatch_s")]
+    for fam in sorted(stats):
+        e = stats[fam]
+        lines.append("%-16s %7d %7d %12.4f"
+                     % (fam, e["calls"], e["builds"], e["dispatch_s"]))
+    return "\n".join(lines)
 
 
 def memory_stats(device=None):

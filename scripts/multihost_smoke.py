@@ -74,6 +74,10 @@ def worker(pid):
     full = m.toarray()  # cross-host gather path
     assert np.allclose(full, x * 2 + 1)
 
+    # first(): the one-record fetch must work when the first shard lives
+    # on another process (jax replicates the int-indexed record)
+    assert np.allclose(b.first(), x[0])
+
     # memory-bounded cross-host collect: force the slab path and assert
     # no single device-side transfer carried the whole array (the VERDICT
     # r1 scenario was process_allgather replicating a 1 TB array on every

@@ -46,3 +46,36 @@ def test_memory_stats_dict():
     assert isinstance(s, dict)  # CPU backend may expose {} or counters
     for k, v in s.items():
         assert isinstance(k, str) and isinstance(v, int)
+
+
+def test_instrument_counts_ops_and_builds(mesh):
+    import bolt_tpu as bolt
+    from bolt_tpu import profile
+    x = np.random.RandomState(0).randn(8, 4, 5)
+    b = bolt.array(x, mesh)
+    f = lambda v: v * 2
+    with profile.instrument() as stats:
+        for _ in range(3):
+            b.map(f).sum().toarray()
+        b.stats()
+    assert "stat" in stats and stats["stat"]["calls"] == 3
+    # one compiled program serves all three identical pipelines
+    assert stats["stat"]["builds"] == 1
+    assert "welford" in stats
+    assert stats["stat"]["dispatch_s"] >= 0.0
+    txt = profile.report(stats)
+    assert "stat" in txt and "builds" in txt
+    # the patch is scoped: outside the context the plain cache is back
+    import bolt_tpu.tpu.array as arr
+    import bolt_tpu.tpu.stats as stats_mod
+    assert arr._cached_jit is stats_mod._cached_jit
+
+
+def test_instrument_detects_recompiles(mesh):
+    import bolt_tpu as bolt
+    from bolt_tpu import profile
+    b = bolt.array(np.random.RandomState(1).randn(8, 4), mesh)
+    with profile.instrument() as stats:
+        for _ in range(3):
+            b.map(lambda v: v + 1).sum().toarray()   # fresh lambda: rebuilds
+    assert stats["stat"]["builds"] == 3              # the smoking gun
