@@ -970,15 +970,16 @@ class BoltArrayTPU(BoltArray):
     def __rfloordiv__(self, other):
         return self._elementwise(other, jnp.floor_divide, reverse=True)
 
-    def _matmul(self, other, reverse=False):
-        """``@`` with ndarray (stacked-matmul) semantics, batched over the
-        key axes: ONE compiled ``jnp.matmul`` on the full logical array —
-        the MXU-shaped path, far better than a per-record map.  The key
-        axes stay key-sharded whenever they survive as leading output axes
-        (batch dims); otherwise (contracted or displaced by broadcasting)
-        the result is re-keyed to ``split=0``."""
+    def _matmul(self, other, reverse=False, op=jnp.matmul):
+        """Contraction with ndarray semantics (``op`` = ``jnp.matmul`` for
+        ``@``, ``jnp.dot`` for :meth:`dot`), batched over the key axes:
+        ONE compiled program on the full logical array — the MXU-shaped
+        path, far better than a per-record map.  The key axes stay
+        key-sharded whenever they survive as leading output axes;
+        otherwise (contracted or displaced by broadcasting) the result is
+        re-keyed to ``split=0``."""
         if isinstance(other, BoltArrayTPU):
-            self._check_mesh(other, "matmul")
+            self._check_mesh(other, op.__name__)
             odata = other._data
         elif isinstance(other, BoltArray):
             odata = jnp.asarray(other.toarray())
@@ -988,15 +989,20 @@ class BoltArrayTPU(BoltArray):
             else self._aval
         b_aval = self._aval if reverse \
             else jax.ShapeDtypeStruct(odata.shape, odata.dtype)
-        # shape/dtype validation without execution; bad shapes raise the
-        # same TypeError numpy's matmul would
-        out_aval = jax.eval_shape(jnp.matmul, a_aval, b_aval)
+        # shape/dtype validation without execution; numpy raises
+        # ValueError for contraction mismatches where jax raises
+        # TypeError — normalise so portable error handling sees one type
+        try:
+            out_aval = jax.eval_shape(op, a_aval, b_aval)
+        except TypeError as e:
+            raise ValueError(str(e)) from None
         out_shape = tuple(out_aval.shape)
         split = self._split
         # keys survive when they still lead the output: self contributes
         # its batch dims plus (non-reverse) its row axis, so key axes past
         # `cap` are contracted; extra broadcast batch dims from a
-        # higher-rank operand displace the keys entirely
+        # higher-rank operand can displace the keys (matmul prepends them;
+        # dot appends, but the conservative re-key is merely suboptimal)
         cap = self.ndim - (2 if reverse else 1)
         new_split = min(split, max(cap, 0))
         if (len(odata.shape) > self.ndim
@@ -1010,12 +1016,12 @@ class BoltArrayTPU(BoltArray):
                 # the numpy oracle to ulp level — TPU's default bf16 passes
                 # would diverge at ~1e-2 (use ops/map with an explicit
                 # precision= for the fast path)
-                out = jnp.matmul(b, a, precision="highest") if reverse \
-                    else jnp.matmul(a, b, precision="highest")
+                out = op(b, a, precision="highest") if reverse \
+                    else op(a, b, precision="highest")
                 return _constrain(out, mesh, new_split)
             return jax.jit(run)
 
-        fn = _cached_jit(("matmul", self.shape, tuple(odata.shape),
+        fn = _cached_jit((op.__name__, self.shape, tuple(odata.shape),
                           str(self.dtype), str(odata.dtype), split, reverse,
                           mesh), build)
         return self._wrap(fn(self._data, odata), new_split)
@@ -1025,6 +1031,48 @@ class BoltArrayTPU(BoltArray):
 
     def __rmatmul__(self, other):
         return self._matmul(other, reverse=True)
+
+    def dot(self, other):
+        """``numpy.dot`` semantics (the ndarray method the local backend
+        inherits): matrix product for 2-d, inner product for 1-d, and for
+        higher ranks the sum-product over self's LAST axis and ``other``'s
+        second-to-last — which differs from ``@``'s stacked matmul.  One
+        compiled MXU program, highest precision."""
+        return self._matmul(other, op=jnp.dot)
+
+    def argsort(self, axis=-1, kind=None):
+        """Indices that would sort along ``axis`` (ndarray semantics:
+        default LAST axis; ``None`` flattens to a 1-d result, re-keyed to
+        a flat key axis like ``cumsum``).  ``kind='stable'`` (or numpy's
+        synonym ``'mergesort'``) guarantees numpy-identical tie order;
+        other kinds sort equal elements in an unspecified (numpy:
+        quicksort's, here XLA's) order."""
+        if kind not in (None, "quicksort", "heapsort", "mergesort",
+                        "stable"):
+            # same rejection as ndarray.argsort on the local backend
+            raise ValueError("sort kind must be one of 'quick', 'heap', "
+                             "or 'stable' (got %r)" % (kind,))
+        stable = kind in ("stable", "mergesort")
+        if axis is not None:
+            axis = self._one_axis(axis)
+        mesh = self._mesh
+        split = self._split
+        new_split = (1 if split else 0) if axis is None else split
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                if axis is None:
+                    out = jnp.argsort(mapped.reshape(-1), stable=stable)
+                else:
+                    out = jnp.argsort(mapped, axis=axis, stable=stable)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("argsort", funcs, base.shape, str(base.dtype),
+                          split, axis, stable, mesh), build)
+        return self._wrap(fn(_check_live(base)), new_split)
 
     # In-place operators: jax arrays are immutable, so these are the
     # functional rebinding form (``b += 1`` rebinds ``b`` to a new array;

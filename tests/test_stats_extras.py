@@ -201,3 +201,64 @@ def test_cumsum_cumprod_parity(mesh):
                     .toarray(), (x + 1).cumsum(axis=0))
     with pytest.raises(TypeError):
         b.cumsum(axis=1.5)               # non-integer axis: ndarray's type
+
+
+# ----------------------------------------------------------------------
+# round-2 ndarray-method parity additions: argsort, dot
+# ----------------------------------------------------------------------
+
+def test_argsort_parity(mesh):
+    x = np.random.RandomState(60).permutation(8 * 5 * 4).reshape(8, 5, 4).astype(np.float64)
+    b = bolt.array(x, mesh)
+    lo = bolt.array(x)
+    # distinct values: any sort kind agrees
+    assert allclose(b.argsort().toarray(), x.argsort())          # last axis
+    assert allclose(b.argsort(axis=0).toarray(), x.argsort(axis=0))
+    assert allclose(b.argsort(axis=-2).toarray(), x.argsort(axis=-2))
+    out = b.argsort(axis=None)
+    assert out.split == 1
+    assert allclose(out.toarray(), x.argsort(axis=None))
+    assert allclose(lo.argsort(axis=1).toarray(), x.argsort(axis=1))
+    # ties: stable kind is numpy-identical on both backends
+    t = np.zeros((6, 3)); t[::2] = 1.0
+    bt = bolt.array(t, mesh)
+    assert allclose(bt.argsort(axis=0, kind="stable").toarray(),
+                    t.argsort(axis=0, kind="stable"))
+    with pytest.raises(TypeError):
+        b.argsort(axis=1.5)
+    # deferred chains fuse in
+    assert allclose(bolt.array(x, mesh).map(lambda v: -v).argsort(axis=0)
+                    .toarray(), (-x).argsort(axis=0))
+
+
+def test_dot_parity(mesh):
+    rs = np.random.RandomState(61)
+    # 2-d @ 2-d
+    a, w = rs.randn(8, 5), rs.randn(5, 3)
+    b = bolt.array(a, mesh)
+    out = b.dot(w)
+    assert out.split == 1
+    assert allclose(out.toarray(), a.dot(w))
+    # 1-d inner product
+    v = rs.randn(5)
+    bv = bolt.array(rs.randn(5).reshape(5), mesh)
+    assert allclose(float(bv.dot(v).toarray()),
+                    float(np.asarray(bv.toarray()).dot(v)))
+    # 3-d . 2-d: dot ≠ matmul for these ranks in general, but matches numpy
+    a3 = rs.randn(8, 4, 5)
+    b3 = bolt.array(a3, mesh)
+    assert allclose(b3.dot(w).toarray(), a3.dot(w))
+    # 3-d . 3-d: the genuinely-different-from-@ case
+    c3 = rs.randn(2, 5, 3)
+    assert allclose(b3.dot(c3).toarray(), a3.dot(c3))
+    # local backend inherits ndarray.dot: same expression both backends
+    assert allclose(bolt.array(a3).dot(w).toarray(), b3.dot(w).toarray())
+    with pytest.raises(ValueError):       # numpy's type for bad contraction
+        b.dot(np.ones((7, 2)))
+    with pytest.raises(ValueError):
+        bolt.array(a3).dot(np.ones((7, 2)))  # identical on the oracle
+    with pytest.raises(ValueError):
+        b.argsort(kind='bogus')              # invalid kind, like ndarray
+    assert allclose(
+        bolt.array(np.zeros((6, 3)), mesh).argsort(axis=0, kind='mergesort')
+        .toarray(), np.zeros((6, 3)).argsort(axis=0, kind='mergesort'))
