@@ -94,16 +94,36 @@ _GATHER_SLAB_BYTES = 256 << 20
 _LAST_GATHER_STATS = None
 
 
-def _cached_jit(key, builder):
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        fn = builder()
-        _JIT_CACHE[key] = fn
-        if len(_JIT_CACHE) > _JIT_CACHE_MAX:
-            _JIT_CACHE.popitem(last=False)
+def _lru_get(cache, key, build):
+    """Shared bounded-LRU policy for the executable and aval caches.
+    NOTE: keys hold strong references to user callables, so a closure
+    capturing a large array stays alive until its entry evicts — the
+    values are the cheap part (executables/avals), the keys are what can
+    pin memory in pathological many-distinct-closures sessions."""
+    out = cache.get(key)
+    if out is None:
+        out = build()
+        cache[key] = out
+        if len(cache) > _JIT_CACHE_MAX:
+            cache.popitem(last=False)
     else:
-        _JIT_CACHE.move_to_end(key)
-    return fn
+        cache.move_to_end(key)
+    return out
+
+
+def _cached_jit(key, builder):
+    return _lru_get(_JIT_CACHE, key, builder)
+
+
+# abstract-shape inference results, keyed on (func identity, input aval):
+# jax.eval_shape re-traces the callable each call (~ms of host work),
+# which at steady state was measured as the dominant per-dispatch
+# framework overhead vs raw jax on small-array pipelines
+_EVAL_CACHE = OrderedDict()
+
+
+def _cached_eval_shape(key, thunk):
+    return _lru_get(_EVAL_CACHE, key, thunk)
 
 
 def _constrain(out, mesh, split):
@@ -370,13 +390,21 @@ class BoltArrayTPU(BoltArray):
 
         try:
             if with_keys:
-                kavals = tuple(jax.ShapeDtypeStruct((), jnp.int32) for _ in range(split))
-                out_aval = jax.eval_shape(
-                    lambda k, v: func((k, v)), kavals,
-                    jax.ShapeDtypeStruct(vshape, aligned._aval.dtype))
+                def infer_wk():
+                    kavals = tuple(jax.ShapeDtypeStruct((), jnp.int32)
+                                   for _ in range(split))
+                    return jax.eval_shape(
+                        lambda k, v: func((k, v)), kavals,
+                        jax.ShapeDtypeStruct(vshape, aligned._aval.dtype))
+                out_aval = _cached_eval_shape(
+                    ("map-wk", func, split, vshape,
+                     str(aligned._aval.dtype)), infer_wk)
             else:
-                out_aval = jax.eval_shape(
-                    func, jax.ShapeDtypeStruct(vshape, aligned._aval.dtype))
+                out_aval = _cached_eval_shape(
+                    ("map", func, vshape, str(aligned._aval.dtype)),
+                    lambda: jax.eval_shape(
+                        func,
+                        jax.ShapeDtypeStruct(vshape, aligned._aval.dtype)))
         except _TRACE_ERRORS as exc:
             # non-traceable func: host fallback through the local oracle
             _warn_fallback("map", func, exc)
@@ -461,8 +489,10 @@ class BoltArrayTPU(BoltArray):
         mesh = self._mesh
 
         try:
-            pred_aval = jax.eval_shape(
-                func, jax.ShapeDtypeStruct(vshape, aligned._aval.dtype))
+            pred_aval = _cached_eval_shape(
+                ("filter", func, vshape, str(aligned._aval.dtype)),
+                lambda: jax.eval_shape(
+                    func, jax.ShapeDtypeStruct(vshape, aligned._aval.dtype)))
         except _TRACE_ERRORS as exc:
             # non-traceable predicate: host fallback through the local oracle
             _warn_fallback("filter", func, exc)
@@ -561,7 +591,9 @@ class BoltArrayTPU(BoltArray):
 
         vaval = jax.ShapeDtypeStruct(vshape, aligned._aval.dtype)
         try:
-            jax.eval_shape(func, vaval, vaval)
+            _cached_eval_shape(
+                ("reduce", func, vshape, str(vaval.dtype)),
+                lambda: jax.eval_shape(func, vaval, vaval))
         except _TRACE_ERRORS as exc:
             # non-traceable reducer: host fallback through the local oracle
             _warn_fallback("reduce", func, exc)
