@@ -86,6 +86,31 @@ lo = smooth(bolt.array(x32), 3, axis=(0,), size=(3,))
 assert lo.dtype == np.float32
 assert np.allclose(sm.toarray(), lo.toarray(), rtol=1e-6, atol=1e-6)
 
+# round-2 surfaces under f32-only production mode
+w64 = np.random.RandomState(1).randn(4, 3)              # f64 operand
+mm = b @ w64
+assert mm.dtype == np.float32                           # no silent f64
+assert np.allclose(mm.toarray(), x32 @ w64.astype(np.float32),
+                   rtol=1e-5, atol=1e-5)
+assert b.dot(w64).dtype == np.float32
+assert np.sin(b).dtype == np.float32                    # ufunc dispatch
+assert (b // 1.0).dtype == np.float32
+assert np.array_equal(np.asarray(b.argsort(axis=0, kind="stable").toarray()),
+                      x32.argsort(axis=0, kind="stable"))
+# stats() through the fused_welford kernel path (128-aligned shard):
+# f32 moments, parity with the f32 oracle
+from bolt_tpu.ops.kernels import welford_plan
+xk = np.random.RandomState(2).randn(32, 4, 128)
+assert welford_plan((32 // 8,) + xk.shape[1:], 4) is not None  # kernel engages
+bk = bolt.array(xk, mesh)
+stk = bk.stats()
+xk32 = xk.astype(np.float32)
+assert np.asarray(stk.mean()).dtype == np.float32
+assert np.allclose(np.asarray(stk.mean()), xk32.mean(axis=0),
+                   rtol=1e-5, atol=1e-6)
+assert np.allclose(np.asarray(stk.variance()), xk32.var(axis=0),
+                   rtol=1e-4, atol=1e-5)
+
 print("X64-OFF-OK")
 """
 
