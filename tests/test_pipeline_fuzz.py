@@ -137,6 +137,26 @@ def _op_normalize(draw, b, x):
     return out, (x - mu) / mu + 3.0
 
 
+def _op_ufunc(draw, b, x):
+    # numpy-ufunc dispatch (round 2): np.tanh(b) must defer into the map
+    # chain on the TPU backend and hit ndarray's machinery locally —
+    # IDENTICAL SPELLING on both.  tanh is bounded and smooth: no
+    # knife-edge thresholds for downstream filters
+    uf = draw(st.sampled_from([np.tanh, np.sin]))
+    return uf(b), uf(x)
+
+
+def _op_matmul(draw, b, x):
+    # @ over the last value axis (round 2): shape-preserving
+    # well-conditioned weight, batched over every leading axis
+    if b.ndim - b.split < 1:
+        return b, x
+    d = x.shape[-1]
+    a = draw(st.sampled_from([1.5, -0.5]))
+    w = np.eye(d) * a + 0.05
+    return b @ w, x @ w
+
+
 def _op_concat_self(draw, b, x):
     if b.split < 1 or x.shape[0] < 1 or x.shape[0] > 8:
         return b, x
@@ -158,7 +178,7 @@ def _op_keys_reshape(draw, b, x):
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
         _op_concat_self, _op_keys_reshape, _op_smooth, _op_normalize,
-        _op_clip]
+        _op_clip, _op_ufunc, _op_matmul]
 
 
 # ----------------------------------------------------------------------
@@ -214,6 +234,16 @@ def _lop_smooth(draw, b, x):
     return smooth(b, w, axis=(0,), size=(c,)), mirror
 
 
+def _lop_matmul(draw, b, x):
+    # the local array has no intrinsic split; treat axis 0 as the key
+    if x.ndim < 2:
+        return b, x
+    d = x.shape[-1]
+    a = draw(st.sampled_from([1.5, -0.5]))
+    w = np.eye(d) * a + 0.05
+    return b @ w, x @ w
+
+
 def _lop_concat_self(draw, b, x):
     if x.shape[0] < 1 or x.shape[0] > 8:
         return b, x
@@ -230,10 +260,10 @@ def _lop_normalize(draw, b, x):
     return (normalize(b, baseline="mean") + 3.0, (x - mu) / mu + 3.0)
 
 
-# _op_operator/_op_slice0/_op_clip are backend-agnostic
+# _op_operator/_op_slice0/_op_clip/_op_ufunc are backend-agnostic
 _LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _op_clip, _lop_filter,
               _lop_chunked_map, _lop_stacked_map, _lop_smooth,
-              _lop_concat_self, _lop_normalize]
+              _lop_concat_self, _lop_normalize, _op_ufunc, _lop_matmul]
 
 
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
