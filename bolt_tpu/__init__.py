@@ -15,16 +15,17 @@ symbol-level citation, SURVEY.md §0):
 
 __version__ = "0.1.0"
 
-from bolt_tpu.factory import (array, concatenate, fromcallback, ones, rand,
-                              randn, zeros)
+from bolt_tpu.factory import (array, concatenate, fromcallback, full, ones,
+                              rand, randn, zeros)
 from bolt_tpu.base import BoltArray, HostFallbackWarning
 from bolt_tpu.local.array import BoltArrayLocal
 from bolt_tpu.tpu.array import BoltArrayTPU
 from bolt_tpu.utils import allclose
 
-__all__ = ["array", "ones", "zeros", "rand", "randn", "fromcallback",
-           "concatenate", "allclose", "BoltArray", "BoltArrayLocal",
-           "BoltArrayTPU", "HostFallbackWarning", "__version__"]
+__all__ = ["array", "ones", "zeros", "full", "rand", "randn",
+           "fromcallback", "concatenate", "allclose", "BoltArray",
+           "BoltArrayLocal", "BoltArrayTPU", "HostFallbackWarning",
+           "__version__"]
 
 _SUBMODULES = ("checkpoint", "profile", "parallel", "ops", "statcounter",
                "utils")

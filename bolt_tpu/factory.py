@@ -61,6 +61,19 @@ def zeros(shape, context=None, axis=(0,), mode=None, dtype=None):
     return ConstructTPU.zeros(shape, context=context, axis=axis, dtype=dtype)
 
 
+def full(shape, value, context=None, axis=(0,), mode=None, dtype=None):
+    """Bolt array filled with ``value`` (numpy ``full`` semantics: the
+    dtype defaults to the fill value's, so ``full(s, 2)`` is integral and
+    ``full(s, 2.0)`` floating; extension beyond the reference factory).
+    ``mode='tpu'`` builds each shard on its own device, like
+    ``ones``/``zeros``."""
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.full(shape, value, dtype=dtype)
+    return ConstructTPU.full(shape, value, context=context, axis=axis,
+                             dtype=dtype)
+
+
 def randn(shape, context=None, axis=(0,), mode=None, dtype=None, seed=0):
     """Bolt array of standard normals (extension beyond the reference
     factory).  ``mode='tpu'`` generates each shard on its own device — no

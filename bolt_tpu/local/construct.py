@@ -32,6 +32,10 @@ class ConstructLocal:
         return BoltArrayLocal(np.zeros(shape, dtype=dtype))
 
     @staticmethod
+    def full(shape, value, dtype=None):
+        return BoltArrayLocal(np.full(shape, value, dtype=dtype))
+
+    @staticmethod
     def _float_dtype(dtype):
         if dtype is not None and not np.issubdtype(np.dtype(dtype),
                                                    np.floating):

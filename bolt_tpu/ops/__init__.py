@@ -1,4 +1,5 @@
-from bolt_tpu.ops.kernels import fused_map_reduce, fused_stats
+from bolt_tpu.ops.hist import histogram
+from bolt_tpu.ops.kernels import fused_map_reduce, fused_stats, fused_welford
 from bolt_tpu.ops.linalg import (corrcoef, cov, jacobi_eigh, lstsq, pca,
                                  svdvals, tallskinny_pca, tallskinny_svd,
                                  tsqr)
@@ -9,6 +10,7 @@ from bolt_tpu.ops.series import (center, crosscorr, detrend, fourier,
 
 __all__ = ["center", "convolve", "corrcoef", "cov", "crosscorr",
            "detrend", "fourier", "fused_map_reduce", "fused_stats",
-           "gaussian", "jacobi_eigh", "lstsq", "map_overlap",
+           "fused_welford", "gaussian", "histogram", "jacobi_eigh",
+           "lstsq", "map_overlap",
            "median_filter", "normalize", "pca", "smooth", "svdvals",
            "tallskinny_pca", "tallskinny_svd", "tsqr", "zscore"]

@@ -228,6 +228,16 @@ class ConstructTPU:
         return ConstructTPU._filled(0, shape, context, axis, dtype)
 
     @staticmethod
+    def full(shape, value, context=None, axis=(0,), dtype=None):
+        """Sharded array filled with ``value``, built directly on device.
+        Like ``numpy.full``, the dtype defaults to the fill value's (so
+        this entry point agrees with the local backend even when called
+        directly, not just through the factory)."""
+        if dtype is None:
+            dtype = np.asarray(value).dtype
+        return ConstructTPU._filled(value, shape, context, axis, dtype)
+
+    @staticmethod
     def concatenate(arrays, axis=0, context=None):
         """Concatenate a sequence of arrays along ``axis`` into one
         distributed array (reference: ``ConstructSpark.concatenate``)."""

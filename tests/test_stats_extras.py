@@ -262,3 +262,56 @@ def test_dot_parity(mesh):
     assert allclose(
         bolt.array(np.zeros((6, 3)), mesh).argsort(axis=0, kind='mergesort')
         .toarray(), np.zeros((6, 3)).argsort(axis=0, kind='mergesort'))
+
+
+def test_full_constructor(mesh):
+    t = bolt.full((8, 4), 2.5, mesh)
+    l = bolt.full((8, 4), 2.5)
+    assert t.mode == "tpu" and l.mode == "local"
+    assert t.dtype == l.dtype == np.float64
+    assert allclose(t.toarray(), np.full((8, 4), 2.5))
+    assert allclose(l.toarray(), np.full((8, 4), 2.5))
+    # numpy's dtype-from-value inference on both backends
+    ti = bolt.full((8, 4), 2, mesh)
+    assert np.issubdtype(ti.dtype, np.integer)
+    assert np.issubdtype(bolt.full((8, 4), 2).dtype, np.integer)
+    assert bolt.full((8, 4), 2, mesh, dtype=np.float32).dtype == np.float32
+
+
+def test_histogram_parity(mesh):
+    from bolt_tpu.ops import histogram
+    x = np.random.RandomState(70).randn(16, 6, 4)
+    tp, lo = bolt.array(x, mesh), bolt.array(x)
+    for kwargs in [dict(), dict(bins=7), dict(bins=5, range=(-1.0, 1.0)),
+                   dict(bins=4, density=True)]:
+        ct, et = histogram(tp, **kwargs)
+        cl, el = histogram(lo, **kwargs)
+        cn, en = np.histogram(x, **kwargs)
+        assert np.allclose(ct, cn) and np.allclose(cl, cn), kwargs
+        assert np.allclose(et, en) and np.allclose(el, en), kwargs
+    # deferred chains fuse in
+    ct, et = histogram(tp.map(lambda v: v * 2), bins=6)
+    cn, en = np.histogram(x * 2, bins=6)
+    assert np.allclose(ct, cn) and np.allclose(et, en)
+    with pytest.raises(ValueError):
+        histogram(tp, bins=0)
+    with pytest.raises(ValueError):
+        histogram(tp, range=(1.0, -1.0))
+
+
+def test_histogram_numpy_edge_semantics(mesh):
+    from bolt_tpu.ops import histogram
+    x = np.random.RandomState(71).randn(8, 4)
+    tp, lo = bolt.array(x, mesh), bolt.array(x)
+    # counts are int64 on BOTH backends (numpy's dtype)
+    assert histogram(tp)[0].dtype == np.int64
+    assert histogram(lo)[0].dtype == np.int64
+    # equal min/max range expands by +-0.5, like numpy's constant case
+    ct, et = histogram(tp, bins=3, range=(1.0, 1.0))
+    cn, en = np.histogram(x, bins=3, range=(1.0, 1.0))
+    assert np.array_equal(ct, cn) and np.allclose(et, en)
+    with pytest.raises(ValueError):
+        histogram(tp, range=(2.0, -1.0))
+    # direct constructor entry point infers dtype like the factory
+    from bolt_tpu.tpu.construct import ConstructTPU
+    assert np.issubdtype(ConstructTPU.full((4, 2), 3, mesh).dtype, np.integer)
