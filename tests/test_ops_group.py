@@ -1,0 +1,135 @@
+"""Segmented (grouped) reductions — the reduceByKey analog — and
+bincount, on both backends vs a NumPy mirror."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.ops import bincount, segment_reduce
+from bolt_tpu.utils import allclose
+
+
+def _x(shape=(12, 4, 3), seed=80):
+    return np.random.RandomState(seed).randn(*shape)
+
+
+def _mirror(x, labels, nseg, op):
+    out = []
+    for g in range(nseg):
+        rows = x[labels == g]
+        if len(rows) == 0:
+            if op in ("sum", "mean"):
+                out.append(np.zeros(x.shape[1:]))
+            else:
+                out.append(np.full(x.shape[1:],
+                                   -np.inf if op == "max" else np.inf))
+        elif op == "sum":
+            out.append(rows.sum(axis=0))
+        elif op == "mean":
+            out.append(rows.mean(axis=0))
+        elif op == "max":
+            out.append(rows.max(axis=0))
+        else:
+            out.append(rows.min(axis=0))
+    return np.stack(out)
+
+
+@pytest.mark.parametrize("op", ["sum", "mean", "max", "min"])
+def test_segment_reduce_parity(mesh, op):
+    x = _x()
+    labels = np.array([0, 2, 1, 0, 2, 2, 1, 0, 3, 3, 0, 2])
+    expected = _mirror(x, labels, 4, op)
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        out = segment_reduce(b, labels, op=op)
+        assert out.shape == (4,) + x.shape[1:]
+        assert allclose(out.toarray(), expected), (b.mode, op)
+    t = segment_reduce(bolt.array(x, mesh), labels, op=op)
+    assert t.split == 1
+
+
+def test_segment_reduce_empty_group_and_num_segments(mesh):
+    x = _x((6, 2))
+    labels = np.array([0, 0, 3, 3, 3, 0])       # groups 1, 2 empty
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        out = np.asarray(segment_reduce(b, labels, num_segments=5).toarray())
+        assert out.shape == (5, 2)
+        assert np.allclose(out[1], 0) and np.allclose(out[2], 0)
+        assert np.allclose(out[4], 0)
+        assert np.allclose(out[0], x[labels == 0].sum(axis=0))
+
+
+def test_segment_reduce_deferred_chain(mesh):
+    x = _x()
+    labels = np.arange(12) % 3
+    b = bolt.array(x, mesh).map(lambda v: v * 2)   # deferred chain fuses in
+    out = segment_reduce(b, labels, op="sum")
+    assert allclose(out.toarray(), _mirror(x * 2, labels, 3, "sum"))
+
+
+def test_segment_reduce_int_mean(mesh):
+    x = np.arange(24, dtype=np.int64).reshape(8, 3)
+    labels = np.array([0, 1, 0, 1, 0, 1, 0, 1])
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        out = np.asarray(segment_reduce(b, labels, op="mean").toarray())
+        assert np.issubdtype(out.dtype, np.floating)
+        assert np.allclose(out, _mirror(x.astype(float), labels, 2, "mean"))
+
+
+def test_segment_reduce_errors(mesh):
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError):
+        segment_reduce(b, np.arange(5))           # wrong length
+    with pytest.raises(ValueError):
+        segment_reduce(b, np.arange(12), op="prod")
+    with pytest.raises(ValueError):
+        segment_reduce(b, np.arange(12) - 1)      # negative label
+    with pytest.raises(ValueError):
+        segment_reduce(b, np.arange(12), num_segments=5)  # label 11 > 4
+    with pytest.raises(ValueError):
+        segment_reduce(b, np.arange(12.0))        # non-integer labels
+
+
+def test_bincount_parity(mesh):
+    x = np.random.RandomState(81).randint(0, 9, size=(16, 5))
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        got = bincount(b)
+        assert got.dtype == np.int64
+        assert np.array_equal(got, np.bincount(x.reshape(-1)))
+        got = bincount(b, minlength=20)
+        assert np.array_equal(got, np.bincount(x.reshape(-1), minlength=20))
+    with pytest.raises(TypeError):
+        bincount(bolt.array(_x(), mesh))          # floats rejected
+    with pytest.raises(ValueError):
+        bincount(bolt.array(x - 5, mesh))         # negatives rejected
+
+
+def test_segment_reduce_multi_key_axes(mesh):
+    # split > 1: labels still address axis-0 records; the other key axes
+    # ride along in the value block on BOTH backends
+    x = _x((4, 2, 3, 2))
+    labels = np.array([0, 1, 0, 1])
+    lo = segment_reduce(bolt.array(x), labels, op="sum")
+    tp = segment_reduce(bolt.array(x, mesh, axis=(0, 1)), labels, op="sum")
+    expected = np.stack([x[labels == g].sum(axis=0) for g in range(2)])
+    assert allclose(lo.toarray(), expected)
+    assert allclose(tp.toarray(), expected)
+
+
+def test_segment_reduce_one_program_many_labels(mesh):
+    # labels are a traced argument: distinct label vectors reuse ONE
+    # compiled program (keying on label bytes would recompile per vector)
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    x = _x()
+    b = bolt.array(x, mesh)
+    segment_reduce(b, np.arange(12) % 4, num_segments=4, op="sum")
+    n_before = sum(1 for k in _JIT_CACHE if k[0] == "segreduce")
+    segment_reduce(b, np.arange(12) % 2 * 3, num_segments=4, op="sum")
+    segment_reduce(b, np.zeros(12, dtype=int), num_segments=4, op="sum")
+    assert sum(1 for k in _JIT_CACHE if k[0] == "segreduce") == n_before
+
+
+def test_bincount_empty(mesh):
+    e = bolt.array(np.zeros((0, 3), np.int64), mesh)
+    assert np.array_equal(bincount(e, minlength=4), np.zeros(4, np.int64))
+    assert np.array_equal(bincount(bolt.array(np.zeros((0,), np.int64)),
+                                   minlength=2), np.zeros(2, np.int64))
