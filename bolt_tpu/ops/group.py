@@ -112,6 +112,71 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
     return BoltArrayTPU(out, 1, mesh)
 
 
+def unique(b, return_counts=False):
+    """``numpy.unique`` over ALL elements (flattened): sorted unique
+    values as a host ndarray, optionally with per-value counts.
+
+    XLA needs static shapes, so the device work is two programs (the
+    filter two-phase pattern, SURVEY §7 hard part 1): sort + first-
+    occurrence mask + count, one scalar sync, then a ``k``-shaped gather
+    of the unique values (and counts as index differences) — the host
+    never receives more than the ``k`` uniques.  Like modern numpy, all
+    NaNs collapse to a single entry (they sort together at the end).
+    """
+    if b.mode == "local":
+        return np.unique(np.asarray(b), return_counts=return_counts)
+
+    from bolt_tpu.tpu.array import _cached_jit, _chain_apply, _check_live
+    base, funcs = b._chain_parts()
+    split = b.split
+    mesh = b.mesh
+    n = int(np.prod(b.shape))
+    if n == 0:
+        empty = np.empty(0, np.dtype(b.dtype))
+        return (empty, np.empty(0, np.int64)) if return_counts else empty
+
+    def phase1_build():
+        def run(data):
+            flat = jnp.sort(_chain_apply(funcs, split, data).reshape(-1))
+            neq = flat[1:] != flat[:-1]
+            if jnp.issubdtype(flat.dtype, jnp.floating):
+                # numpy collapses NaNs to one entry; sorted NaNs are
+                # contiguous at the end, so "both NaN" marks duplicates
+                neq &= ~(jnp.isnan(flat[1:]) & jnp.isnan(flat[:-1]))
+            mask = jnp.concatenate([jnp.ones(1, bool), neq])
+            return flat, mask, jnp.sum(mask, dtype=jnp.int32)
+        return jax.jit(run)
+
+    sorted_, mask, cnt = _cached_jit(
+        ("unique-sort", funcs, base.shape, str(base.dtype), split, mesh),
+        phase1_build)(_check_live(base))
+    k = int(jax.device_get(cnt))               # the one unavoidable sync
+
+    def phase2_build():
+        def run(s, m):
+            idx = jnp.nonzero(m, size=k, fill_value=n)[0]
+            uniq = jnp.take(s, idx, axis=0, mode="clip")
+            if not return_counts:
+                return (uniq,)   # skip the counts work and their transfer
+            ends = jnp.concatenate(
+                [idx[1:], jnp.asarray([n], idx.dtype)])
+            # canonical int on device (int32 when x64 is off — no warning);
+            # the host result is widened to int64 after the fetch
+            return uniq, (ends - idx).astype(
+                jax.dtypes.canonicalize_dtype(np.int64))
+        return jax.jit(run)
+
+    # n is the chain-OUTPUT element count (a shape-changing map can alter
+    # it), so the key carries funcs and n like every other chain consumer
+    out = jax.device_get(_cached_jit(
+        ("unique-gather", funcs, base.shape, str(base.dtype), split, n, k,
+         return_counts, mesh), phase2_build)(sorted_, mask))
+    uniq = np.asarray(out[0])
+    if return_counts:
+        return uniq, np.asarray(out[1]).astype(np.int64)
+    return uniq
+
+
 def bincount(b, minlength=0):
     """``numpy.bincount`` over ALL elements of an integer bolt array
     (flattened, like numpy), as one compiled program; returns a host

@@ -78,6 +78,14 @@ def worker(pid):
     # on another process (jax replicates the int-indexed record)
     assert np.allclose(b.first(), x[0])
 
+    # grouped reduction: the scatter combine spans processes (records of
+    # one group live on different hosts' shards)
+    from bolt_tpu.ops import segment_reduce
+    glabels = np.arange(nkeys) % 3
+    gout = np.asarray(segment_reduce(b, glabels, op="sum").toarray())
+    gexp = np.stack([x[glabels == g].sum(axis=0) for g in range(3)])
+    assert np.allclose(gout, gexp)
+
     # memory-bounded cross-host collect: force the slab path and assert
     # no single device-side transfer carried the whole array (the VERDICT
     # r1 scenario was process_allgather replicating a 1 TB array on every

@@ -133,3 +133,36 @@ def test_bincount_empty(mesh):
     assert np.array_equal(bincount(e, minlength=4), np.zeros(4, np.int64))
     assert np.array_equal(bincount(bolt.array(np.zeros((0,), np.int64)),
                                    minlength=2), np.zeros(2, np.int64))
+
+
+def test_unique_parity(mesh):
+    from bolt_tpu.ops import unique
+    x = np.random.RandomState(82).randint(0, 7, size=(9, 4)).astype(np.float64)
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        u = unique(b)
+        assert np.array_equal(u, np.unique(x)), b.mode
+        u, c = unique(b, return_counts=True)
+        un, cn = np.unique(x, return_counts=True)
+        assert np.array_equal(u, un) and np.array_equal(c, cn), b.mode
+    # ints, all-same, and deferred chains
+    i = bolt.array(np.full((4, 3), 5), mesh)
+    u, c = unique(i, return_counts=True)
+    assert np.array_equal(u, [5]) and np.array_equal(c, [12])
+    m = bolt.array(x, mesh).map(lambda v: v * 0 + 2.0)
+    assert np.array_equal(unique(m), [2.0])
+    # empty
+    e = bolt.array(np.zeros((0, 3)), mesh)
+    u, c = unique(e, return_counts=True)
+    assert u.size == 0 and c.size == 0
+
+
+def test_unique_nan_semantics(mesh):
+    from bolt_tpu.ops import unique
+    x = np.array([[1.0, np.nan], [np.nan, 1.0]])
+    un, cn = np.unique(x, return_counts=True)
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        u, c = unique(b, return_counts=True)
+        # modern numpy collapses NaNs to one entry; counts aggregate
+        assert u.shape == un.shape, b.mode
+        assert np.isnan(u[-1]) and u[0] == 1.0
+        assert np.array_equal(c, cn), b.mode
