@@ -128,6 +128,20 @@ def fam_halo_gaussian():
         iters=4, keep_all=False)
 
 
+def fam_segment_reduce():
+    from bolt_tpu.ops import segment_reduce
+    # few records x big blocks: the public API uploads labels per call,
+    # so the label vector is kept tiny (32 KB) — a 131072-label variant
+    # measured the tunnel (~30 of 39 ms/iter), not the scatter combine
+    shape = (8192, 1024, 64)                      # 2.1 GB
+    b = bolt.randn(shape, mode="tpu", seed=9, dtype=np.float32).cache()
+    labels = np.arange(shape[0]) % 256
+
+    return int(np.prod(shape)) * 4, steady(
+        lambda: segment_reduce(b, labels, num_segments=256, op="sum"),
+        iters=5)
+
+
 def fam_pca():
     from bolt_tpu.ops import pca
     b = bolt.randn((33554432, 16), mode="tpu", seed=5).cache()  # 2.1 GB
@@ -145,6 +159,7 @@ FAMILIES = [
     ("filter_fused", fam_filter_fused),
     ("matmul", fam_matmul),
     ("halo_gaussian", fam_halo_gaussian),
+    ("segment_reduce", fam_segment_reduce),
     ("pca", fam_pca),
 ]
 

@@ -111,6 +111,22 @@ assert np.allclose(np.asarray(stk.mean()), xk32.mean(axis=0),
 assert np.allclose(np.asarray(stk.variance()), xk32.var(axis=0),
                    rtol=1e-4, atol=1e-5)
 
+# grouped/set ops under f32-only
+from bolt_tpu.ops import bincount, histogram, segment_reduce, unique
+glabels = np.arange(64) % 4
+gs = segment_reduce(b, glabels, op="mean")
+assert gs.dtype == np.float32
+assert np.allclose(np.asarray(gs.toarray()),
+                   np.stack([x32[glabels == g].mean(axis=0)
+                             for g in range(4)]), rtol=1e-5, atol=1e-6)
+iv = bolt.array((np.abs(x64) * 3).astype(np.int32), mesh)
+assert np.array_equal(bincount(iv),
+                      np.bincount((np.abs(x32) * 3).astype(np.int32).ravel()))
+cu, eu = histogram(b, bins=8)
+assert cu.dtype == np.int64 and cu.sum() == x32.size
+uu = unique(bolt.array(np.floor(x64 * 2), mesh))
+assert np.array_equal(uu, np.unique(np.floor(x32 * 2)))
+
 print("X64-OFF-OK")
 """
 
