@@ -1,4 +1,4 @@
-from bolt_tpu.ops.group import bincount, segment_reduce, unique
+from bolt_tpu.ops.group import bincount, segment_reduce, topk, unique
 from bolt_tpu.ops.hist import histogram
 from bolt_tpu.ops.kernels import fused_map_reduce, fused_stats, fused_welford
 from bolt_tpu.ops.linalg import (corrcoef, cov, jacobi_eigh, lstsq, pca,
@@ -10,7 +10,7 @@ from bolt_tpu.ops.series import (center, crosscorr, detrend, fourier,
                                  normalize, zscore)
 
 __all__ = ["bincount", "center", "convolve", "corrcoef", "cov",
-           "crosscorr", "segment_reduce", "unique",
+           "crosscorr", "segment_reduce", "topk", "unique",
            "detrend", "fourier", "fused_map_reduce", "fused_stats",
            "fused_welford", "gaussian", "histogram", "jacobi_eigh",
            "lstsq", "map_overlap",

@@ -112,6 +112,71 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
     return BoltArrayTPU(out, 1, mesh)
 
 
+def topk(b, k, axis=-1):
+    """Largest ``k`` values (descending) and their indices along ``axis``
+    — ``jax.lax.top_k`` semantics, one compiled program; returns
+    ``(values, indices)`` bolt arrays whose ``axis`` dimension becomes
+    ``k``.  Ties keep the lower index first, like ``lax.top_k`` (numpy
+    has no direct analog; ``argpartition`` leaves ties unordered).
+    ``mode='local'`` computes the same thing in NumPy (including
+    ``lax.top_k``'s NaN-is-largest ordering)."""
+    from numbers import Integral
+    if not isinstance(k, Integral):
+        raise TypeError("k must be an integer, got %r" % (k,))
+    k = int(k)
+    ndim = b.ndim
+    if not isinstance(axis, (int, np.integer)):
+        raise TypeError("axis must be an integer, got %r" % (axis,))
+    axis = int(axis)
+    if axis < 0:
+        axis += ndim
+    if axis < 0 or axis >= ndim:
+        raise ValueError("axis out of range for %d-d array" % ndim)
+    if not 1 <= k <= b.shape[axis]:
+        raise ValueError("k=%d out of range for axis of size %d"
+                         % (k, b.shape[axis]))
+
+    if b.mode == "local":
+        x = np.asarray(b)
+        moved = np.moveaxis(x, axis, -1)
+        # descending order with lax.top_k's tie/NaN semantics, WITHOUT
+        # negating (negation wraps unsigned/INT_MIN and rejects bools):
+        # stable-ascending-argsort the index-reversed array (ties there
+        # resolve to the HIGHER original index), map back, reverse —
+        # descending, ties to the LOWER index, NaNs first (largest)
+        L = moved.shape[-1]
+        idx_rev = np.argsort(moved[..., ::-1], axis=-1, kind="stable")
+        desc = (L - 1 - idx_rev)[..., ::-1]
+        idx = desc[..., :k]
+        vals = np.take_along_axis(moved, idx, axis=-1)
+        from bolt_tpu.local.array import BoltArrayLocal
+        return (BoltArrayLocal(np.moveaxis(vals, -1, axis)),
+                BoltArrayLocal(np.moveaxis(idx, -1, axis)))
+
+    from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _chain_apply,
+                                    _check_live, _constrain)
+    base, funcs = b._chain_parts()
+    split = b.split
+    mesh = b.mesh
+    # the axis keeps its key/value role (its size becomes k; a
+    # non-dividing key size just falls back to replication in the spec)
+
+    def build():
+        def run(data):
+            x = _chain_apply(funcs, split, data)
+            moved = jnp.moveaxis(x, axis, -1)
+            vals, idx = jax.lax.top_k(moved, k)
+            return (_constrain(jnp.moveaxis(vals, -1, axis), mesh, split),
+                    _constrain(jnp.moveaxis(idx, -1, axis), mesh, split))
+        return jax.jit(run)
+
+    vals, idx = _cached_jit(
+        ("topk", funcs, base.shape, str(base.dtype), split, axis, k, mesh),
+        build)(_check_live(base))
+    return (BoltArrayTPU(vals, split, mesh),
+            BoltArrayTPU(idx, split, mesh))
+
+
 def unique(b, return_counts=False):
     """``numpy.unique`` over ALL elements (flattened): sorted unique
     values as a host ndarray, optionally with per-value counts.

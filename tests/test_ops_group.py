@@ -166,3 +166,67 @@ def test_unique_nan_semantics(mesh):
         assert u.shape == un.shape, b.mode
         assert np.isnan(u[-1]) and u[0] == 1.0
         assert np.array_equal(c, cn), b.mode
+
+
+def test_topk_parity(mesh):
+    from bolt_tpu.ops import topk
+    x = np.random.RandomState(83).randn(8, 6, 5)
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        for axis in (-1, 0, 1):
+            v, i = topk(b, 3, axis=axis)
+            moved = np.moveaxis(x, axis, -1)
+            ref_i = np.argsort(-moved, axis=-1, kind="stable")[..., :3]
+            ref_v = np.take_along_axis(moved, ref_i, axis=-1)
+            assert allclose(v.toarray(), np.moveaxis(ref_v, -1, axis)), (b.mode, axis)
+            assert np.array_equal(np.asarray(i.toarray()),
+                                  np.moveaxis(ref_i, -1, axis)), (b.mode, axis)
+    t, _ = topk(bolt.array(x, mesh), 3, axis=2)
+    assert t.split == 1 and t.shape == (8, 6, 3)
+    # key-axis topk keeps the key role
+    t, _ = topk(bolt.array(x, mesh), 2, axis=0)
+    assert t.split == 1 and t.shape == (2, 6, 5)
+    # ties: lower index first on both backends
+    z = np.zeros((4, 4))
+    for b in (bolt.array(z), bolt.array(z, mesh)):
+        _, i = topk(b, 2)
+        assert np.array_equal(np.asarray(i.toarray()), np.tile([0, 1], (4, 1)))
+
+
+def test_topk_errors(mesh):
+    from bolt_tpu.ops import topk
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError):
+        topk(b, 0)
+    with pytest.raises(ValueError):
+        topk(b, 99, axis=0)
+    with pytest.raises(ValueError):
+        topk(b, 1, axis=9)
+    with pytest.raises(TypeError):
+        topk(b, 1, axis=1.5)
+    # deferred chain fuses in
+    v, _ = topk(bolt.array(_x(), mesh).map(lambda r: -r), 2, axis=0)
+    moved = np.moveaxis(-_x(), 0, -1)
+    ref = np.moveaxis(np.take_along_axis(
+        moved, np.argsort(-moved, axis=-1, kind="stable")[..., :2], -1), -1, 0)
+    assert allclose(v.toarray(), ref)
+
+
+def test_topk_dtype_and_nan_parity(mesh):
+    # the review's repro set: unsigned wrap, INT_MIN, bools, NaNs — both
+    # backends must agree with lax.top_k semantics
+    from bolt_tpu.ops import topk
+    cases = [
+        np.array([[5, 0, 3]], dtype=np.uint32),
+        np.array([[np.iinfo(np.int32).min, 4, -2]], dtype=np.int32),
+        np.array([[True, False, True]]),
+        np.array([[1.0, np.nan, 3.0, 2.0]]),
+    ]
+    for x in cases:
+        lo_v, lo_i = topk(bolt.array(x), 2)
+        tp_v, tp_i = topk(bolt.array(x, mesh), 2)
+        lv, tv = np.asarray(lo_v.toarray()), np.asarray(tp_v.toarray())
+        assert np.array_equal(lv, tv, equal_nan=True), (x.dtype, lv, tv)
+        assert np.array_equal(np.asarray(lo_i.toarray()),
+                              np.asarray(tp_i.toarray())), x.dtype
+    with pytest.raises(TypeError):
+        topk(bolt.array(cases[0], mesh), 2.7)
