@@ -9,6 +9,15 @@ one JSON line per family, writes ``PERF.json``, and — when a committed
 by more than ``THRESHOLD`` (exit code 2, so CI can warn without
 conflating regressions with failures).
 
+BASELINE CONVENTION: the committed baseline records a conservative
+LOW-WATER mark per family — the worst throughput observed across
+healthy measurement windows — because this environment's attach-window
+variance spans 2-4× on some families (swap measured 148-655 GB/s in one
+day with identical code).  The gate therefore fires on genuine
+collapses, not on drawing an unlucky window against a lucky baseline.
+A plain ``--rebaseline`` records the CURRENT window; hand-adjust toward
+the low-water mark after collecting a few runs.
+
 Usage::
 
     python scripts/perf_regress.py              # measure + compare
