@@ -146,6 +146,14 @@ class BoltArrayLocal(np.ndarray, BoltArray):
             minValue=x.min(axis=axes), maxValue=x.max(axis=axes),
             stats=requested)
 
+    def ptp(self, axis=None, keepdims=False):
+        """Peak-to-peak (max − min).  numpy ≥2 removed the ndarray method
+        in favour of ``np.ptp``; this restores it with ndarray reduction
+        conventions (``axis=None`` reduces everything), matching this
+        backend's inherited mean/sum family."""
+        return BoltArrayLocal(np.ptp(np.asarray(self), axis=axis,
+                                     keepdims=keepdims))
+
     def quantile(self, q, axis=(0,), keepdims=False, method="linear"):
         """The ``q``-th quantile over ``axis`` (default: the leading axis,
         this backend's default key axis; ``None`` means the same, matching

@@ -636,7 +636,7 @@ class BoltArrayTPU(BoltArray):
     # reductions whose cross-device combine is the psum tree GSPMD inserts)
     # ------------------------------------------------------------------
 
-    def _stat(self, axis, name, keepdims=False):
+    def _stat(self, axis, name, keepdims=False, ddof=None):
         if axis is None:
             axes = tuple(range(self._split)) if self._split else tuple(range(self.ndim))
         else:
@@ -652,28 +652,39 @@ class BoltArrayTPU(BoltArray):
         def build():
             op = {"mean": jnp.mean, "var": jnp.var, "std": jnp.std,
                   "sum": jnp.sum, "max": jnp.max, "min": jnp.min,
-                  "prod": jnp.prod, "all": jnp.all, "any": jnp.any}[name]
+                  "prod": jnp.prod, "all": jnp.all, "any": jnp.any,
+                  "ptp": jnp.ptp}[name]
+            kwargs = {} if ddof is None else {"ddof": ddof}
 
             def stat(data):
                 mapped = _chain_apply(funcs, split, data)
-                out = op(mapped, axis=axes, keepdims=keepdims)
+                out = op(mapped, axis=axes, keepdims=keepdims, **kwargs)
                 return _constrain(out, mesh, new_split)
             return jax.jit(stat)
 
         fn = _cached_jit(("stat", name, funcs, base.shape, str(base.dtype),
-                          split, axes, keepdims, mesh), build)
+                          split, axes, keepdims, ddof, mesh), build)
         return self._wrap(fn(_check_live(base)), new_split)
 
     def mean(self, axis=None, keepdims=False):
         """Mean over ``axis`` (default: all key axes)."""
         return self._stat(axis, "mean", keepdims)
 
-    def var(self, axis=None, keepdims=False):
-        """Population variance (ddof=0, matching the reference StatCounter)."""
-        return self._stat(axis, "var", keepdims)
+    def var(self, axis=None, keepdims=False, ddof=0):
+        """Variance over ``axis`` (``ddof=0`` population default, matching
+        the reference StatCounter; ``ddof=1`` for the sample variance,
+        like the ndarray method the local backend inherits; fractional
+        ddof passes through like numpy's)."""
+        return self._stat(axis, "var", keepdims, ddof=ddof)
 
-    def std(self, axis=None, keepdims=False):
-        return self._stat(axis, "std", keepdims)
+    def std(self, axis=None, keepdims=False, ddof=0):
+        """Standard deviation over ``axis`` (``ddof`` like :meth:`var`)."""
+        return self._stat(axis, "std", keepdims, ddof=ddof)
+
+    def ptp(self, axis=None, keepdims=False):
+        """Peak-to-peak (max − min) over ``axis`` — the ndarray method
+        (numpy ≥2 spells it ``np.ptp``); one compiled program."""
+        return self._stat(axis, "ptp", keepdims)
 
     def sum(self, axis=None, keepdims=False):
         return self._stat(axis, "sum", keepdims)

@@ -111,3 +111,35 @@ def test_sum_bit_exact_integral(mesh):
     b = bolt.array(x, mesh)
     assert allclose(b.sum().toarray(), x.sum(axis=0))
     assert float(b.sum(axis=(0, 1)).toarray()) == float(x.sum())
+
+
+def test_var_std_ddof(mesh):
+    x = _x()
+    b, lo = bolt.array(x, mesh), bolt.array(x)
+    assert allclose(b.var(axis=(0,), ddof=1).toarray(), x.var(axis=0, ddof=1))
+    assert allclose(b.std(axis=(0,), ddof=1).toarray(), x.std(axis=0, ddof=1))
+    # the local backend inherits ddof from ndarray: same expression works
+    assert allclose(np.asarray(lo.var(axis=0, ddof=1)), x.var(axis=0, ddof=1))
+    # default stays population (ddof=0), matching StatCounter
+    assert allclose(b.var(axis=(0,)).toarray(), x.var(axis=0))
+
+
+def test_ptp(mesh):
+    x = _x()
+    b, lo = bolt.array(x, mesh), bolt.array(x)
+    assert allclose(b.ptp(axis=(0,)).toarray(), np.ptp(x, axis=0))
+    assert allclose(b.ptp(axis=(0, 1, 2)).toarray(), np.ptp(x))
+    # key-axis default on TPU; ndarray-convention (all axes) locally —
+    # the documented reduction-family asymmetry
+    assert allclose(b.ptp().toarray(), np.ptp(x, axis=0))
+    assert float(np.asarray(lo.ptp().toarray())) == np.ptp(x)
+    assert allclose(np.asarray(lo.ptp(axis=1).toarray()), np.ptp(x, axis=1))
+
+
+def test_var_fractional_ddof(mesh):
+    x = _x()
+    b, lo = bolt.array(x, mesh), bolt.array(x)
+    assert allclose(b.var(axis=(0,), ddof=1.5).toarray(),
+                    x.var(axis=0, ddof=1.5))
+    assert allclose(np.asarray(lo.var(axis=0, ddof=1.5)),
+                    x.var(axis=0, ddof=1.5))
