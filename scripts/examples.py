@@ -203,6 +203,32 @@ def main():
     assert np.allclose(np.asarray(q90.toarray()),
                        np.quantile(tr10, 0.9, axis=1), atol=1e-8)
 
+    # ------------------------------------------------------------------
+    section("11. grouped analysis: segment_reduce + topk + histogram")
+    # per-condition trial averages (reduceByKey), the strongest responders
+    # per condition, and the response distribution — all on-mesh
+    from bolt_tpu.ops import histogram, segment_reduce, topk, unique
+    rs11 = np.random.RandomState(11)
+    ntrial, cond = 64, rs11.randint(0, 4, size=64)
+    resp = rs11.randn(ntrial, 32) + cond[:, None] * 0.5   # condition effect
+    rb = bolt.array(resp, mesh, axis=(0,))
+    means = segment_reduce(rb, cond, num_segments=4, op="mean")
+    got = np.asarray(means.toarray())
+    for g in range(4):
+        assert np.allclose(got[g], resp[cond == g].mean(axis=0), atol=1e-6)
+    # group means should be ordered by the injected effect
+    assert got.mean(axis=1)[0] < got.mean(axis=1)[3]
+    vals, idx = topk(means, 3, axis=1)     # strongest channels per group
+    ref_idx = np.argsort(-got, axis=1, kind="stable")[:, :3]
+    assert np.array_equal(np.asarray(idx.toarray()), ref_idx)
+    assert np.allclose(np.asarray(vals.toarray()),
+                       np.take_along_axis(got, ref_idx, axis=1))
+    counts, edges = histogram(rb, bins=12)
+    cn, en = np.histogram(resp, bins=12)
+    assert np.array_equal(counts, cn) and np.allclose(edges, en)
+    labels_seen = unique(bolt.array(cond, mesh))
+    assert np.array_equal(labels_seen, np.unique(cond))
+
     print("ALL EXAMPLES OK")
 
 
