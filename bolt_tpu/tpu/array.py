@@ -1028,9 +1028,12 @@ class BoltArrayTPU(BoltArray):
             odata = jnp.asarray(other.toarray())
         else:
             odata = self._coerce_operand(other)
+        # self.shape (not _aval, which is None on a pending filter result)
+        # resolves the lazy survivor count first
+        self_aval = jax.ShapeDtypeStruct(self.shape, self.dtype)
         a_aval = jax.ShapeDtypeStruct(odata.shape, odata.dtype) if reverse \
-            else self._aval
-        b_aval = self._aval if reverse \
+            else self_aval
+        b_aval = self_aval if reverse \
             else jax.ShapeDtypeStruct(odata.shape, odata.dtype)
         # shape/dtype validation without execution; numpy raises
         # ValueError for contraction mismatches where jax raises

@@ -219,3 +219,46 @@ def test_new_stats_on_pending_filter(mesh):
     assert allclose(f.cumsum(axis=0).toarray(), keep.cumsum(axis=0))
     assert allclose(f.clip(-0.5, 0.5).toarray(), keep.clip(-0.5, 0.5))
     assert allclose(f.prod().toarray(), keep.prod(axis=0))
+
+
+def test_new_ops_on_pending_filter_results(mesh):
+    # a filter result is PENDING (survivor count unsynced) until its shape
+    # is read; every round-2 op must resolve it transparently
+    import bolt_tpu as bolt
+    from bolt_tpu.ops import histogram, segment_reduce, topk, unique
+    x = np.random.RandomState(90).randn(16, 4, 6)
+    keep = x.reshape(16, -1).mean(axis=1) > 0
+    xs = x[keep]
+    n = xs.shape[0]
+    assert 2 <= n < 16   # the filter actually drops something
+
+    def pending():
+        b = bolt.array(x, mesh).filter(lambda v: v.mean() > 0)
+        assert b.pending
+        return b
+
+    out = segment_reduce(pending(), np.arange(n) % 2, op="sum")
+    ref = np.stack([xs[np.arange(n) % 2 == g].sum(axis=0) for g in range(2)])
+    assert allclose(out.toarray(), ref)
+
+    v, i = topk(pending(), 2, axis=0)
+    ref_i = np.argsort(-np.moveaxis(xs, 0, -1), axis=-1, kind="stable")[..., :2]
+    assert np.array_equal(np.asarray(i.toarray()),
+                          np.moveaxis(ref_i, -1, 0))
+    assert allclose(v.toarray(), np.moveaxis(np.take_along_axis(
+        np.moveaxis(xs, 0, -1), ref_i, axis=-1), -1, 0))
+
+    c, e = histogram(pending(), bins=5)
+    cn, en = np.histogram(xs, bins=5)
+    assert np.array_equal(c, cn) and np.allclose(e, en)
+
+    u = unique(pending().map(np.floor))
+    assert np.array_equal(u, np.unique(np.floor(xs)))
+
+    assert allclose(pending().ptp(axis=(0,)).toarray(), np.ptp(xs, axis=0))
+    assert allclose(pending().var(axis=(0,), ddof=1).toarray(),
+                    xs.var(axis=0, ddof=1))
+    assert allclose((pending() @ np.ones((6, 2))).toarray(),
+                    xs @ np.ones((6, 2)))
+    assert allclose(pending().argsort(axis=0, kind="stable").toarray(),
+                    xs.argsort(axis=0, kind="stable"))
