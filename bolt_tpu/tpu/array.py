@@ -1086,6 +1086,64 @@ class BoltArrayTPU(BoltArray):
         compiled MXU program, highest precision."""
         return self._matmul(other, op=jnp.dot)
 
+    def take(self, indices, axis=None, mode="raise"):
+        """Select elements by index (the ndarray method the local backend
+        inherits): ``axis=None`` indexes the flattened array (result
+        re-keyed to a flat key axis), an int axis gathers along it —
+        numpy semantics, one compiled program.  ``mode``: ``'raise'``
+        (default — negative wrap, out-of-bounds rejected), ``'wrap'``
+        (modular), ``'clip'``.  Index-dtype rules follow numpy exactly:
+        float NDARRAYS are rejected, float sequences/scalars truncate,
+        booleans cast to 0/1 (not masks)."""
+        if mode not in ("raise", "wrap", "clip"):
+            raise ValueError("mode must be 'raise', 'wrap' or 'clip', "
+                             "got %r" % (mode,))
+        arraylike = isinstance(indices, np.ndarray) or (
+            hasattr(indices, "__array__")
+            and not isinstance(indices, (list, tuple)))
+        idx = np.asarray(indices)
+        if idx.dtype == bool:
+            idx = idx.astype(np.intp)
+        elif not np.issubdtype(idx.dtype, np.integer):
+            if arraylike:
+                raise TypeError(
+                    "Cannot cast take indices from %s to integer"
+                    % (idx.dtype,))
+            idx = np.trunc(idx).astype(np.intp)   # numpy truncates sequences
+        if axis is not None:
+            axis = self._one_axis(axis)
+        dim = prod(self.shape) if axis is None else self.shape[axis]
+        if mode == "wrap":
+            wrapped = idx % dim
+        elif mode == "clip":
+            wrapped = np.clip(idx, 0, dim - 1)
+        else:
+            wrapped = np.where(idx < 0, idx + dim, idx)
+            if idx.size and (wrapped.min() < 0 or wrapped.max() >= dim):
+                raise IndexError(
+                    "take index out of bounds for size %d" % dim)
+        mesh = self._mesh
+        split = self._split
+        new_split = (1 if split and idx.ndim else 0) if axis is None \
+            else (split if axis >= split or idx.ndim == 1
+                  else split + idx.ndim - 1)
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data, ids):
+                mapped = _chain_apply(funcs, split, data)
+                if axis is None:
+                    out = jnp.take(mapped.reshape(-1), ids, axis=0)
+                else:
+                    out = jnp.take(mapped, ids, axis=axis)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("take", funcs, base.shape, str(base.dtype),
+                          split, axis, idx.shape, mesh), build)
+        out = fn(_check_live(base), jnp.asarray(wrapped, dtype=jnp.int32))
+        return self._wrap(out, new_split)
+
     def argsort(self, axis=-1, kind=None):
         """Indices that would sort along ``axis`` (ndarray semantics:
         default LAST axis; ``None`` flattens to a 1-d result, re-keyed to

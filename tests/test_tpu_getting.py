@@ -101,3 +101,54 @@ def test_negative_and_mixed_index_forms(mesh):
     assert allclose(np.asarray(b[2, -1, ::2].toarray()), x[2, -1, ::2])
     assert b[5:2].toarray().shape == x[5:2].shape
     assert allclose(b[1, [0, 2], :].toarray(), x[1][[0, 2], :])
+
+
+def test_take_parity(mesh):
+    # ndarray.take: inherited locally, compiled program on TPU
+    x = _x()
+    b, lo = bolt.array(x, mesh), bolt.array(x)
+    for kwargs in [dict(indices=[2, 0, 5]), dict(indices=[1, -1], axis=0),
+                   dict(indices=[3, 1], axis=1),
+                   dict(indices=[0, 2, 4], axis=2),
+                   dict(indices=[[0, 1], [2, 3]], axis=0),
+                   dict(indices=7)]:
+        ref = x.take(**kwargs)
+        t = b.take(**kwargs)
+        l = lo.take(**kwargs)
+        assert np.asarray(t.toarray()).shape == ref.shape, kwargs
+        assert allclose(t.toarray(), ref), kwargs
+        assert allclose(np.asarray(l), ref), kwargs
+    # split bookkeeping
+    assert b.take([1, 0], axis=0).split == 1
+    assert b.take(0, axis=0).split == 0
+    assert b.take([1, 0], axis=2).split == 1
+    assert b.take([[0, 1], [2, 3]], axis=0).split == 2
+    # errors match numpy's classes
+    with pytest.raises(IndexError):
+        b.take([9999])               # OOB for the flattened 160 elements
+    with pytest.raises(IndexError):
+        b.take([8], axis=0)
+    # deferred chains fuse in
+    assert allclose(bolt.array(x, mesh).map(lambda v: v * 2)
+                    .take([1, 3], axis=0).toarray(), (x * 2).take([1, 3], 0))
+
+
+def test_take_numpy_dtype_and_mode_semantics(mesh):
+    # numpy's exact quirks: float NDARRAYS rejected, float sequences and
+    # scalars truncate, bools are 0/1 indices, mode= clips/wraps
+    x = _x()
+    b, lo = bolt.array(x, mesh), bolt.array(x)
+    for args in [([True, False],), ([2.7],), (1.5,), ([-1.5],)]:
+        ref = x.take(*args)
+        assert allclose(np.asarray(b.take(*args).toarray()), ref), args
+        assert allclose(np.asarray(lo.take(*args)), ref), args
+    with pytest.raises(TypeError):
+        b.take(np.array([1.5]))
+    with pytest.raises(TypeError):
+        b.take(np.array([], dtype=float))
+    assert allclose(np.asarray(b.take([9999], mode="clip").toarray()),
+                    x.take([9999], mode="clip"))
+    assert allclose(np.asarray(b.take([-3, 175], axis=None, mode="wrap").toarray()),
+                    x.take([-3, 175], mode="wrap"))
+    with pytest.raises(ValueError):
+        b.take([0], mode="nope")
