@@ -51,7 +51,7 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
                          % (int(labels.max()), num_segments))
 
     if b.mode == "local":
-        x = np.asarray(b).reshape((n,) + b.shape[1:])
+        x = np.asarray(b)
         vshape = x.shape[1:]
         if op in ("sum", "mean"):
             if op == "mean" and not np.issubdtype(x.dtype, np.floating):
@@ -74,8 +74,8 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
         from bolt_tpu.local.array import BoltArrayLocal
         return BoltArrayLocal(out)
 
-    from bolt_tpu.tpu.array import (_cached_jit, _chain_apply, _check_live,
-                                    _constrain)
+    from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _chain_apply,
+                                    _check_live, _constrain)
     base, funcs = b._chain_parts()
     split = b.split
     mesh = b.mesh
@@ -108,7 +108,6 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
     fn = _cached_jit(("segreduce", op, funcs, base.shape, str(base.dtype),
                       split, num_segments, mesh), build)
     out = fn(_check_live(base), jnp.asarray(labels, dtype=jnp.int32))
-    from bolt_tpu.tpu.array import BoltArrayTPU
     return BoltArrayTPU(out, 1, mesh)
 
 
