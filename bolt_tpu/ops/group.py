@@ -17,45 +17,86 @@ import jax.numpy as jnp
 _OPS = ("sum", "mean", "max", "min")
 
 
+@jax.jit
+def _minmax_program(lab):
+    # module-level jit: ONE compiled program per label aval (a per-call
+    # inner @jax.jit would recompile every call — jit keys on function
+    # identity; measured 1.09 s vs 0.11 s per segment_reduce on chip)
+    return jnp.min(lab), jnp.max(lab)
+
+
+def _label_minmax(labels):
+    """``(min, max)`` of a device labels array as Python ints — ONE host
+    sync of two scalars (the data itself never leaves the device)."""
+    mn, mx = jax.device_get(_minmax_program(labels))
+    return int(mn), int(mx)
+
+
 def segment_reduce(b, labels, num_segments=None, op="sum"):
     """Reduce the records of ``b`` (leading key axis) into groups given by
     ``labels``: record ``i`` joins group ``labels[i]``, and group ``g``'s
     result is the ``op``-combine of its records — the ``reduceByKey``
     analog, one compiled program.
 
-    ``labels``: 1-d integers of length ``b.shape[0]`` (host or device).
-    ``num_segments``: static group count (defaults to ``labels.max() + 1``,
-    which costs one host sync on a device ``labels``); groups with no
-    records get ``0`` for sum/mean and the dtype's identity (∓inf → the
-    op's init) for max/min, matching ``jax.ops.segment_max/min``.
+    ``labels``: 1-d integers of length ``b.shape[0]``.  A host sequence /
+    ndarray ships to the device once; a ``jax.Array`` (or a bolt TPU
+    array) STAYS on device — range validation is one two-scalar sync, the
+    label data itself never round-trips through the host.
+    ``num_segments``: static group count (defaults to ``labels.max() + 1``
+    — free on host labels, part of the same two-scalar sync on device
+    labels); groups with no records get ``0`` for sum/mean and the
+    dtype's identity (∓inf → the op's init) for max/min, matching
+    ``jax.ops.segment_max/min``.  ``op='mean'`` on integer input promotes
+    through the canonical float (float64 under x64, float32 on a
+    production x64-off TPU) on BOTH backends, so the backends agree under
+    either x64 setting.
     Returns a bolt array shaped ``(num_segments, *value_shape)`` with
     ``split=1`` (``mode='local'`` computes the same thing in NumPy).
     """
     if op not in _OPS:
         raise ValueError("op must be one of %s, got %r" % (_OPS, op))
-    labels = np.asarray(labels)
-    if labels.ndim != 1 or not np.issubdtype(labels.dtype, np.integer):
+    from bolt_tpu.base import BoltArray
+    if isinstance(labels, BoltArray):
+        if labels.mode == "tpu":
+            if b.mode == "tpu":
+                b._check_mesh(labels, "segment_reduce labels")
+            labels = labels.tojax()
+        else:
+            labels = np.asarray(labels)
+    device_labels = isinstance(labels, jax.Array) and b.mode == "tpu"
+    if not device_labels:
+        labels = np.asarray(labels)
+    if labels.ndim != 1 or not np.issubdtype(
+            np.dtype(labels.dtype), np.integer):
         raise ValueError("labels must be 1-d integers, got shape %s dtype %s"
                          % (labels.shape, labels.dtype))
     n = b.shape[0]
     if labels.shape[0] != n:
         raise ValueError("labels length %d != leading axis %d"
                          % (labels.shape[0], n))
-    if labels.size and labels.min() < 0:
+    if device_labels:
+        lmin, lmax = _label_minmax(labels) if labels.size else (0, -1)
+    else:
+        lmin = int(labels.min()) if labels.size else 0
+        lmax = int(labels.max()) if labels.size else -1
+    if labels.size and lmin < 0:
         raise ValueError("labels must be non-negative")
     if num_segments is None:
-        num_segments = int(labels.max()) + 1 if labels.size else 0
+        num_segments = lmax + 1 if labels.size else 0
     num_segments = int(num_segments)
-    if labels.size and labels.max() >= num_segments:
+    if labels.size and lmax >= num_segments:
         raise ValueError("label %d out of range for num_segments=%d"
-                         % (int(labels.max()), num_segments))
+                         % (lmax, num_segments))
 
     if b.mode == "local":
         x = np.asarray(b)
         vshape = x.shape[1:]
         if op in ("sum", "mean"):
             if op == "mean" and not np.issubdtype(x.dtype, np.floating):
-                x = x.astype(np.float64)    # mean of ints is floating
+                # mean of ints is floating — promote through the CANONICAL
+                # float (f64 under x64, f32 otherwise) so this oracle and
+                # the TPU path return the same dtype under either setting
+                x = x.astype(jax.dtypes.canonicalize_dtype(np.float64))
             out = np.zeros((num_segments,) + vshape, x.dtype)
             np.add.at(out, labels, x)
             if op == "mean":
@@ -88,6 +129,7 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
             # records = axis-0 groups, like the labels contract; further
             # key axes just ride along in the value block (the local
             # oracle path flattens identically)
+            lab = lab.astype(jnp.int32)
             flat = _chain_apply(funcs, split, data)
             if op == "mean" and not jnp.issubdtype(flat.dtype, jnp.floating):
                 # mean of ints is floating (f64 under x64, like numpy)
@@ -104,10 +146,12 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
 
     # labels is a traced argument (its length is pinned by base.shape), so
     # distinct label vectors REUSE one compiled program — never key on
-    # label content
+    # label content; device labels pass through untouched (the int32 cast
+    # happens inside the program — no host round-trip)
     fn = _cached_jit(("segreduce", op, funcs, base.shape, str(base.dtype),
                       split, num_segments, mesh), build)
-    out = fn(_check_live(base), jnp.asarray(labels, dtype=jnp.int32))
+    lab = labels if device_labels else jnp.asarray(labels, dtype=jnp.int32)
+    out = fn(_check_live(base), lab)
     return BoltArrayTPU(out, 1, mesh)
 
 
@@ -241,14 +285,24 @@ def unique(b, return_counts=False):
     return uniq
 
 
+# bincount accumulates per-chunk below this element count when the
+# canonical int is int32 (x64 off), so no bin can reach 2**31 inside one
+# device program; chunk partials combine in host int64.  None = automatic
+# (engages only when x64 is off AND the array is big enough to wrap);
+# tests set it small to force the chunked path.
+_BINCOUNT_CHUNK = None
+
+
 def bincount(b, minlength=0):
     """``numpy.bincount`` over ALL elements of an integer bolt array
     (flattened, like numpy), as one compiled program; returns a host
     int64 ndarray of length ``max(minlength, max(b) + 1)``.  The length
     must be static for XLA, so a device-side max costs one scalar sync
     when ``minlength`` doesn't already cover it.  Counts accumulate in
-    the canonical int (int64 under x64; int32 on a production TPU, where
-    a single bin would overflow past 2**31-1 occurrences)."""
+    the canonical int; when that is int32 (x64 off, the production-TPU
+    default) arrays big enough for a single bin to pass 2**31−1 are
+    counted in chunks whose int32 partials combine in host int64 — the
+    result is exact at any size, matching the local backend."""
     if not np.issubdtype(np.dtype(b.dtype), np.integer):
         raise TypeError("bincount requires an integer array, got %s"
                         % (b.dtype,))
@@ -275,6 +329,42 @@ def bincount(b, minlength=0):
     if int(mn) < 0:
         raise ValueError("bincount requires non-negative values")
     length = max(minlength, int(mx) + 1)
+
+    n_elems = int(np.prod(b.shape))
+    chunk = _BINCOUNT_CHUNK
+    if chunk is None and jax.dtypes.canonicalize_dtype(np.int64) != np.int64:
+        chunk = (1 << 31) - (1 << 20)
+    if chunk is not None and n_elems > chunk:
+        # x32 wraparound guard: each device program counts < 2**31
+        # elements (its int32 per-bin partial cannot wrap); partials
+        # combine exactly in host int64.  Chunk starts stay STATIC —
+        # dynamic-start slices of sharded operands make GSPMD all-gather
+        # the whole array (BASELINE.md) — so it is one program per chunk;
+        # at the default ~2**31 chunk a 16 GB chip holds at most a
+        # handful of chunks.
+        total = np.zeros(length, np.int64)
+        # materialise any deferred chain ONCE (a per-chunk program would
+        # re-run the whole chain before slicing its window)
+        data = b._data
+        for start in range(0, n_elems, chunk):
+            stop = min(start + chunk, n_elems)
+
+            def chunk_build(start=start, stop=stop):
+                def run(d):
+                    x = d.reshape(-1)
+                    return jax.ops.segment_sum(
+                        jnp.ones(stop - start,
+                                 jax.dtypes.canonicalize_dtype(np.int64)),
+                        jax.lax.slice_in_dim(x, start, stop),
+                        num_segments=length)
+                return jax.jit(run)
+
+            part = _cached_jit(
+                ("bincount-chunk", data.shape, str(data.dtype),
+                 length, start, stop, mesh),
+                chunk_build)(data)
+            total += np.asarray(jax.device_get(part)).astype(np.int64)
+        return total
 
     def build():
         def run(data):

@@ -115,6 +115,63 @@ def test_segment_reduce_multi_key_axes(mesh):
     assert allclose(tp.toarray(), expected)
 
 
+def test_segment_reduce_device_labels_no_host_bounce(mesh, monkeypatch):
+    # a jax.Array (or bolt TPU array) labels input must stay on device:
+    # the label DATA never passes through np.asarray (ADVICE r2 / VERDICT
+    # r2 #4 — through the real chip's ~17 MB/s tunnel the bounce costs
+    # seconds); only the two-scalar range validation syncs
+    import jax.numpy as jnp
+    from bolt_tpu.ops import group
+    x = _x()
+    labels_host = np.array([0, 2, 1, 0, 2, 2, 1, 0, 3, 3, 0, 2])
+    expected = _mirror(x, labels_host, 4, "sum")
+    dev_labels = jnp.asarray(labels_host)
+
+    bounced = []
+    real_asarray = np.asarray
+
+    def spy(a, *args, **kwargs):
+        if a is dev_labels:
+            bounced.append(a)
+        return real_asarray(a, *args, **kwargs)
+
+    monkeypatch.setattr(group.np, "asarray", spy)
+    b = bolt.array(x, mesh)
+    for nseg in (None, 4):
+        out = segment_reduce(b, dev_labels, num_segments=nseg, op="sum")
+        assert allclose(out.toarray(), expected)
+    assert not bounced
+    # bolt TPU-array labels unwrap to the device array, same guarantee
+    blabels = bolt.array(labels_host, mesh)
+    out = segment_reduce(b, blabels, op="sum")
+    assert allclose(out.toarray(), expected)
+    # device labels still validate range
+    with pytest.raises(ValueError):
+        segment_reduce(b, jnp.asarray(labels_host - 1))
+    with pytest.raises(ValueError):
+        segment_reduce(b, dev_labels, num_segments=2)
+    # foreign-mesh bolt labels are rejected loudly, like binary operands
+    import jax
+    other_mesh = jax.make_mesh((4, 2), ("a", "b"))
+    with pytest.raises(ValueError, match="different meshes"):
+        segment_reduce(b, bolt.array(labels_host, other_mesh))
+
+
+def test_bincount_chunked_accumulation(mesh, monkeypatch):
+    # force the x32-wraparound chunked path (ADVICE r2): int32 partials
+    # per chunk, host-int64 combine — result identical to the one-shot
+    # program at any chunk size, including a ragged tail
+    from bolt_tpu.ops import group
+    x = np.random.RandomState(84).randint(0, 9, size=(16, 5))
+    expected = np.bincount(x.reshape(-1), minlength=11)
+    monkeypatch.setattr(group, "_BINCOUNT_CHUNK", 17)   # 80 elems -> 5 chunks
+    got = bincount(bolt.array(x, mesh), minlength=11)
+    assert got.dtype == np.int64
+    assert np.array_equal(got, expected)
+    monkeypatch.setattr(group, "_BINCOUNT_CHUNK", 80)   # exact fit: no chunking
+    assert np.array_equal(bincount(bolt.array(x, mesh), minlength=11), expected)
+
+
 def test_segment_reduce_one_program_many_labels(mesh):
     # labels are a traced argument: distinct label vectors reuse ONE
     # compiled program (keying on label bytes would recompile per vector)

@@ -119,6 +119,13 @@ assert gs.dtype == np.float32
 assert np.allclose(np.asarray(gs.toarray()),
                    np.stack([x32[glabels == g].mean(axis=0)
                              for g in range(4)]), rtol=1e-5, atol=1e-6)
+# int-input mean promotes through the CANONICAL float on BOTH backends:
+# f32 here (x64 off), so the oracle and the TPU path agree on dtype
+ints = np.arange(24, dtype=np.int32).reshape(8, 3)
+ilabels = np.arange(8) % 2
+for ib in (bolt.array(ints), bolt.array(ints, mesh)):
+    im = segment_reduce(ib, ilabels, op="mean")
+    assert np.asarray(im.toarray()).dtype == np.float32, ib.mode
 iv = bolt.array((np.abs(x64) * 3).astype(np.int32), mesh)
 assert np.array_equal(bincount(iv),
                       np.bincount((np.abs(x32) * 3).astype(np.int32).ravel()))
