@@ -157,20 +157,16 @@ class BoltArrayLocal(np.ndarray, BoltArray):
     def quantile(self, q, axis=(0,), keepdims=False, method="linear"):
         """The ``q``-th quantile over ``axis`` (default: the leading axis,
         this backend's default key axis; ``None`` means the same, matching
-        ``stats``).  Scalar ``q`` only, matching the distributed backend;
+        ``stats``).  ``q``: a scalar, or a 1-d array that prepends a q
+        axis like ``np.quantile`` — matching the distributed backend;
         superset of the reference."""
-        try:
-            q = float(q)
-        except (TypeError, ValueError):
-            raise ValueError(
-                "q must be a scalar in [0, 1]; call quantile once per q")
-        if not 0.0 <= q <= 1.0:
-            raise ValueError("q must be in [0, 1], got %r" % (q,))
+        from bolt_tpu.utils import check_q
+        qarr = check_q(q)
         axes = (0,) if axis is None else tuple(sorted(tupleize(axis)))
         inshape(self.shape, axes)
         return BoltArrayLocal(np.quantile(
-            np.asarray(self), q, axis=axes, keepdims=keepdims,
-            method=method))
+            np.asarray(self), qarr if qarr.ndim else float(q), axis=axes,
+            keepdims=keepdims, method=method))
 
     def median(self, axis=(0,), keepdims=False):
         """Median over ``axis`` (default: the leading axis)."""

@@ -767,17 +767,15 @@ class BoltArrayTPU(BoltArray):
     def quantile(self, q, axis=None, keepdims=False, method="linear"):
         """The ``q``-th quantile over ``axis`` (default: all key axes) —
         one compiled program (XLA sorts on device; GSPMD gathers the
-        reduced axes as needed).  ``q`` is a scalar in [0, 1]; superset of
-        the reference (no quantiles in Bolt/StatCounter)."""
-        try:
-            q = float(q)
-        except (TypeError, ValueError):
-            raise ValueError(
-                "q must be a scalar in [0, 1] (per-q results would "
-                "prepend an axis that is neither key nor value); call "
-                "quantile once per q")
-        if not 0.0 <= q <= 1.0:
-            raise ValueError("q must be in [0, 1], got %r" % (q,))
+        reduced axes as needed).  ``q``: a scalar or a 1-d array of values
+        in [0, 1]; a 1-d ``q`` prepends a q axis to the result, exactly
+        like ``np.quantile`` — that new axis is a flat KEY axis (the same
+        convention as ``filter``'s flat output key), so the remaining key
+        axes stay leading.  Superset of the reference (no quantiles in
+        Bolt/StatCounter)."""
+        from bolt_tpu.utils import check_q
+        qarr = check_q(q)
+        vector_q = qarr.ndim == 1
         if axis is None:
             axes = tuple(range(self._split)) if self._split \
                 else tuple(range(self.ndim))
@@ -787,13 +785,15 @@ class BoltArrayTPU(BoltArray):
         mesh = self._mesh
         split = self._split
         nkeys_reduced = sum(1 for a in axes if a < split)
-        new_split = split if keepdims else split - nkeys_reduced
+        new_split = (split if keepdims else split - nkeys_reduced) \
+            + (1 if vector_q else 0)
         base, funcs = self._chain_parts()
 
         def build():
             # q is a traced ARGUMENT, not a trace constant: sweeping many
             # quantiles reuses one compiled program instead of recompiling
-            # (and re-caching) per q
+            # (and re-caching) per q (per q-LENGTH for vector q — jit
+            # retraces per aval internally)
             def stat(data, qv):
                 mapped = _chain_apply(funcs, split, data)
                 xf = mapped.astype(jnp.promote_types(mapped.dtype,
@@ -804,9 +804,10 @@ class BoltArrayTPU(BoltArray):
             return jax.jit(stat)
 
         fn = _cached_jit(("quantile", method, funcs, base.shape,
-                          str(base.dtype), split, axes, keepdims, mesh),
-                         build)
-        return self._wrap(fn(_check_live(base), q), new_split)
+                          str(base.dtype), split, axes, keepdims, vector_q,
+                          mesh), build)
+        return self._wrap(fn(_check_live(base),
+                             qarr if vector_q else float(q)), new_split)
 
     def median(self, axis=None, keepdims=False):
         """Median over ``axis`` (default: all key axes)."""

@@ -272,6 +272,26 @@ def check_value_shape(hint, inferred):
                          % (tuple(tupleize(hint)), tuple(inferred)))
 
 
+def check_q(q):
+    """Validate a quantile ``q`` (scalar or 1-d, every value in [0, 1])
+    and return it as a float64 ndarray — shared by both backends so the
+    contract cannot drift.  NaN is rejected explicitly: on the TPU
+    backend q is a traced jit argument, so a NaN that slipped past
+    validation would silently produce an all-NaN result instead of this
+    error."""
+    try:
+        qarr = np.asarray(q, dtype=np.float64)
+    except (TypeError, ValueError):
+        raise ValueError(
+            "q must be a scalar or 1-d array of values in [0, 1], got %r"
+            % (q,))
+    if qarr.ndim > 1:
+        raise ValueError("q must be a scalar or 1-d, got %d-d" % qarr.ndim)
+    if qarr.size and not (np.all(qarr >= 0.0) and np.all(qarr <= 1.0)):
+        raise ValueError("q must be in [0, 1], got %r" % (q,))
+    return qarr
+
+
 def chunk_plan(vshape, itemsize, size, axes, padding=None):
     """Per-value-axis chunk sizes.  A string ``size`` is a per-block
     megabyte budget (the reference's ``size='150'`` default) — the largest

@@ -51,14 +51,63 @@ def test_quantile_axes_and_median(mesh):
                     np.median(x * 2, axis=0))
 
 
+def test_quantile_vector_q(mesh):
+    # 1-d q prepends a q axis like np.quantile; on the TPU backend the new
+    # axis is a flat KEY axis (filter's output convention)
+    x = _x()
+    qs = [0.1, 0.5, 0.9]
+    expect = np.quantile(x, qs, axis=0)
+    t = bolt.array(x, mesh).quantile(qs)
+    l = bolt.array(x).quantile(np.asarray(qs))
+    assert t.shape == expect.shape and l.shape == expect.shape
+    assert t.split == 1
+    assert allclose(t.toarray(), expect)
+    assert allclose(l.toarray(), expect)
+    # keepdims, multi key axes: q axis leads, reduced dims stay as 1s
+    b2 = bolt.array(x, mesh, axis=(0, 1))
+    e2 = np.quantile(x, qs, axis=(0, 1), keepdims=True)
+    t2 = b2.quantile(qs, keepdims=True)
+    assert t2.shape == e2.shape and allclose(t2.toarray(), e2)
+    assert t2.split == 3                  # q + the two kept key axes
+    # value-axis vector quantile keeps the original key axes AFTER q
+    t3 = bolt.array(x, mesh).quantile(qs, axis=(2,))
+    assert allclose(t3.toarray(), np.quantile(x, qs, axis=2))
+    assert t3.split == 2
+    # two q-lengths reuse the same _cached_jit entry (jit retraces per aval)
+    from bolt_tpu.tpu import array as array_mod
+    n_before = sum(1 for k in array_mod._JIT_CACHE if k[0] == "quantile")
+    bolt.array(x, mesh).quantile([0.2, 0.4, 0.6, 0.8]).toarray()
+    assert sum(1 for k in array_mod._JIT_CACHE
+               if k[0] == "quantile") == n_before
+    # vector-q median equivalence through quantile; single-element q keeps
+    # the axis (numpy semantics)
+    t1 = bolt.array(x, mesh).quantile([0.5])
+    assert t1.shape == (1,) + x.shape[1:]
+    assert allclose(t1.toarray(), np.quantile(x, [0.5], axis=0))
+
+
 def test_quantile_validation(mesh):
     b = bolt.array(_x(), mesh)
     with pytest.raises(ValueError):
         b.quantile(1.5)
     with pytest.raises(ValueError):
-        b.quantile([0.2, 0.8])           # scalar-only contract
+        b.quantile([0.2, 1.8])           # out of range inside a vector
     with pytest.raises(ValueError):
-        bolt.array(_x()).quantile((0.2, 0.8))
+        bolt.array(_x()).quantile((0.2, -0.8))
+    with pytest.raises(ValueError):
+        b.quantile([[0.2], [0.8]])       # 2-d q rejected on both backends
+    with pytest.raises(ValueError):
+        bolt.array(_x()).quantile([[0.2], [0.8]])
+    with pytest.raises(ValueError):
+        b.quantile("half")
+    # NaN q is rejected up front on BOTH backends (q is a traced argument
+    # on tpu — a NaN past validation would silently return all-NaN)
+    with pytest.raises(ValueError):
+        b.quantile(float("nan"))
+    with pytest.raises(ValueError):
+        b.quantile([0.5, float("nan")])
+    with pytest.raises(ValueError):
+        bolt.array(_x()).quantile(float("nan"))
 
 
 def test_cov_parity(mesh):
