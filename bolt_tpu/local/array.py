@@ -207,6 +207,25 @@ class BoltArrayLocal(np.ndarray, BoltArray):
     # indexing
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _mixes_advanced(index):
+        """True when ``index`` (a tuple) mixes advanced entries in a way
+        where numpy's zipped convention diverges from this framework's
+        orthogonal one: two or more advanced (list/ndarray) indices, or
+        one advanced index with a scalar alongside (a scalar counts as a
+        0-d advanced index to numpy, whose "separated advanced indices
+        move to the front" rule would then diverge).  Shared by
+        ``__getitem__`` and ``__setitem__`` so read and write semantics
+        cannot desynchronize."""
+        nadv = sum(1 for i in index
+                   if isinstance(i, (list, np.ndarray))
+                   and not (isinstance(i, np.ndarray) and i.ndim == 0))
+        nscalar = sum(1 for i in index
+                      if isinstance(i, (int, np.integer))
+                      or (isinstance(i, np.ndarray) and i.ndim == 0
+                          and i.dtype != bool))
+        return nadv >= 2 or bool(nadv and nscalar)
+
     def __getitem__(self, index):
         """ndarray indexing, EXCEPT that two or more advanced (list /
         ndarray / boolean) indices apply orthogonally per axis (``np.ix_``
@@ -219,19 +238,7 @@ class BoltArrayLocal(np.ndarray, BoltArray):
         if not isinstance(index, tuple):
             # a lone index can never mix advanced entries: ndarray fast path
             return super().__getitem__(index)
-        idx = index
-        nadv = sum(1 for i in idx
-                   if isinstance(i, (list, np.ndarray))
-                   and not (isinstance(i, np.ndarray) and i.ndim == 0))
-        nscalar = sum(1 for i in idx
-                      if isinstance(i, (int, np.integer))
-                      or (isinstance(i, np.ndarray) and i.ndim == 0
-                          and i.dtype != bool))
-        # numpy's zipped convention only matches the orthogonal one for a
-        # single advanced index with no scalars alongside (a scalar counts
-        # as a 0-d advanced index to numpy, whose "separated advanced
-        # indices move to the front" rule would then diverge)
-        if nadv < 2 and not (nadv and nscalar):
+        if not self._mixes_advanced(index):
             return super().__getitem__(index)
         from bolt_tpu.utils import normalize_index
         norm, squeezed = normalize_index(index, self.shape)
@@ -244,6 +251,40 @@ class BoltArrayLocal(np.ndarray, BoltArray):
             out = out.reshape(tuple(
                 s for i, s in enumerate(out.shape) if i not in squeezed))
         return BoltArrayLocal(out)
+
+    # ------------------------------------------------------------------
+    # mutation (the distributed backend's device arrays are immutable;
+    # ``set`` is the functional update both backends share, and this
+    # backend's inherited in-place ``__setitem__`` is overridden only to
+    # keep ≥2 advanced indices orthogonal, matching ``__getitem__``)
+    # ------------------------------------------------------------------
+
+    def set(self, index, value):
+        """Functional indexed update: a NEW array equal to this one with
+        ``self[index] = value`` applied — same indexing semantics as
+        ``__getitem__`` (two or more advanced indices apply
+        orthogonally); ``value`` broadcasts against the selected region
+        and casts to this dtype (numpy assignment semantics).  Mirrors
+        the distributed backend's method, where device arrays cannot be
+        assigned in place."""
+        from bolt_tpu.utils import assignment_index, normalize_index
+        norm, squeezed = normalize_index(index, self.shape)
+        out = np.array(self)
+        out[assignment_index(norm, self.shape, squeezed)] = value
+        return BoltArrayLocal(out)
+
+    def __setitem__(self, index, value):
+        """ndarray in-place assignment, EXCEPT that multiple-advanced
+        (and scalar-plus-advanced) indices assign to the region
+        ``__getitem__`` with the same index would read — the ORTHOGONAL
+        per-axis cross product, dims in axis order — matching this
+        backend's ``__getitem__`` and both backends' ``set`` (same
+        rerouting condition as ``__getitem__``)."""
+        if isinstance(index, tuple) and self._mixes_advanced(index):
+            from bolt_tpu.utils import assignment_index, normalize_index
+            norm, squeezed = normalize_index(index, self.shape)
+            index = assignment_index(norm, self.shape, squeezed)
+        return super().__setitem__(index, value)
 
     # ------------------------------------------------------------------
     # conversions

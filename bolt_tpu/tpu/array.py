@@ -185,6 +185,16 @@ def _check_live(arr):
     return arr
 
 
+def _check_sort_kind(kind):
+    """Shared ``kind`` validation for sort/argsort (numpy's exact
+    rejection wording); returns True when numpy-identical tie order is
+    guaranteed."""
+    if kind not in (None, "quicksort", "heapsort", "mergesort", "stable"):
+        raise ValueError("sort kind must be one of 'quick', 'heap', "
+                         "or 'stable' (got %r)" % (kind,))
+    return kind in ("stable", "mergesort")
+
+
 def _chain_apply(funcs, split, data):
     """Apply a deferred map chain: each func nested-vmapped over the
     ``split`` leading key axes, in order."""
@@ -1152,12 +1162,7 @@ class BoltArrayTPU(BoltArray):
         synonym ``'mergesort'``) guarantees numpy-identical tie order;
         other kinds sort equal elements in an unspecified (numpy:
         quicksort's, here XLA's) order."""
-        if kind not in (None, "quicksort", "heapsort", "mergesort",
-                        "stable"):
-            # same rejection as ndarray.argsort on the local backend
-            raise ValueError("sort kind must be one of 'quick', 'heap', "
-                             "or 'stable' (got %r)" % (kind,))
-        stable = kind in ("stable", "mergesort")
+        stable = _check_sort_kind(kind)
         if axis is not None:
             axis = self._one_axis(axis)
         mesh = self._mesh
@@ -1178,6 +1183,381 @@ class BoltArrayTPU(BoltArray):
         fn = _cached_jit(("argsort", funcs, base.shape, str(base.dtype),
                           split, axis, stable, mesh), build)
         return self._wrap(fn(_check_live(base)), new_split)
+
+    # ------------------------------------------------------------------
+    # inherited-ndarray method surface (the local backend gets all of
+    # these from ``numpy.ndarray``; providing them here keeps
+    # mode-agnostic code running on both backends — VERDICT r2 missing-2.
+    # Reference: ``bolt/local/array.py`` — the ndarray subclass)
+    # ------------------------------------------------------------------
+
+    def sort(self, axis=-1, kind=None):
+        """Sort along ``axis`` IN PLACE and return ``None`` — the ndarray
+        calling convention the local backend inherits.  Device buffers
+        are immutable, so "in place" is at the wrapper level: this handle
+        rebinds to the sorted array (other handles, and the numpy views
+        the local backend can alias, are unaffected — this backend has no
+        views).  ``kind`` accepts ndarray.sort's names; values are
+        identical under any of them."""
+        _check_sort_kind(kind)
+        axis = self._one_axis(axis)
+        mesh, split = self._mesh, self._split
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                return _constrain(jnp.sort(mapped, axis=axis), mesh, split)
+            return jax.jit(run)
+
+        out = _cached_jit(("sort", funcs, base.shape, str(base.dtype),
+                           split, axis, mesh), build)(_check_live(base))
+        self._concrete = out
+        self._chain = None
+        self._aval = jax.ShapeDtypeStruct(out.shape, out.dtype)
+        return None
+
+    def ravel(self, order="C"):
+        """Flatten to 1-d, the result keyed by a single flat key axis
+        (``filter``'s output convention; a ``split=0`` input stays
+        value-only).  ``order='F'`` flattens column-major (a reversed
+        transpose on device); ``'A'``/``'K'`` follow the LOGICAL C
+        layout — device arrays have no host memory order for them to
+        inspect (the only divergence from numpy: a non-contiguous local
+        oracle view could answer 'A'/'K' in F order)."""
+        if order not in ("C", "F", "A", "K"):
+            raise ValueError(
+                "order must be one of 'C', 'F', 'A', or 'K' (got %r)"
+                % (order,))
+        fortran = order == "F"
+        mesh, split = self._mesh, self._split
+        new_split = 1 if split else 0
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                if fortran:
+                    mapped = mapped.transpose(range(mapped.ndim)[::-1])
+                return _constrain(mapped.reshape(-1), mesh, new_split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("ravel", funcs, base.shape, str(base.dtype),
+                          split, fortran, mesh), build)
+        return self._wrap(fn(_check_live(base)), new_split)
+
+    def flatten(self, order="C"):
+        """Flattened copy (``ndarray.flatten``); identical to
+        :meth:`ravel` here — both produce a fresh device array."""
+        return self.ravel(order=order)
+
+    def repeat(self, repeats, axis=None):
+        """Repeat elements (ndarray semantics: ``axis=None`` flattens
+        first; ``repeats`` a scalar, or a 1-d array matching the axis
+        length — floats truncate like numpy).  The output length is
+        computed on host, so the compiled program has a static shape;
+        an array ``repeats`` is a traced argument (distinct repeat
+        vectors of one total length reuse a program)."""
+        rep = np.asarray(repeats)
+        if rep.ndim > 1:
+            raise ValueError("object too deep for desired array")
+        if rep.dtype == bool or not np.issubdtype(rep.dtype, np.integer):
+            rep = np.trunc(rep).astype(np.int64)   # numpy truncates floats
+        if rep.size and rep.min() < 0:
+            raise ValueError("negative dimensions are not allowed")
+        if axis is not None:
+            axis = self._one_axis(axis)
+        dim = prod(self.shape) if axis is None else self.shape[axis]
+        if rep.ndim == 1 and rep.size not in (1, dim):
+            raise ValueError(
+                "operands could not be broadcast together with shape "
+                "(%d,) (%d,)" % (dim, rep.size))
+        if rep.ndim == 1 and rep.size == 1:
+            rep = np.full(dim, rep[0])      # numpy broadcasts size-1 repeats
+        total = int(rep.sum()) if rep.ndim else int(rep) * dim
+        mesh, split = self._mesh, self._split
+        new_split = split if axis is not None else (1 if split else 0)
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data, r):
+                mapped = _chain_apply(funcs, split, data)
+                out = jnp.repeat(mapped, r, axis=axis,
+                                 total_repeat_length=total)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("repeat", funcs, base.shape, str(base.dtype),
+                          split, axis, rep.shape, total, mesh), build)
+        return self._wrap(fn(_check_live(base), jnp.asarray(rep)), new_split)
+
+    def _diag_axes(self, axis1, axis2):
+        axis1 = self._one_axis(axis1)
+        axis2 = self._one_axis(axis2)
+        if axis1 == axis2:
+            raise ValueError("axis1 and axis2 cannot be the same")
+        return axis1, axis2
+
+    def diagonal(self, offset=0, axis1=0, axis2=1):
+        """Diagonal over the (``axis1``, ``axis2``) planes (ndarray
+        semantics: both axes are removed and the diagonal appears as the
+        LAST axis — a value axis; remaining key axes stay leading)."""
+        axis1, axis2 = self._diag_axes(axis1, axis2)
+        offset = int(offset)
+        mesh, split = self._mesh, self._split
+        new_split = split - sum(1 for a in (axis1, axis2) if a < split)
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                out = jnp.diagonal(mapped, offset, axis1, axis2)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("diagonal", funcs, base.shape, str(base.dtype),
+                          split, offset, axis1, axis2, mesh), build)
+        return self._wrap(fn(_check_live(base)), new_split)
+
+    def trace(self, offset=0, axis1=0, axis2=1, dtype=None):
+        """Sum of the (``axis1``, ``axis2``) diagonal.  The accumulator
+        dtype is whatever numpy's ``ndarray.trace`` would produce for
+        this input (asked of numpy directly, then canonicalised), so the
+        backends agree — e.g. int8/bool promote to the canonical int."""
+        axis1, axis2 = self._diag_axes(axis1, axis2)
+        offset = int(offset)
+        # numpy decides the output dtype (probe on an empty 2-d); the
+        # backend canonicalises it (int64→int32 when x64 is off)
+        target = _canon(np.empty((1, 1), dtype=self.dtype)
+                        .trace(dtype=dtype).dtype)
+        mesh, split = self._mesh, self._split
+        new_split = split - sum(1 for a in (axis1, axis2) if a < split)
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                out = jnp.diagonal(mapped, offset, axis1, axis2)
+                out = jnp.sum(out.astype(target), axis=-1)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(run)
+
+        fn = _cached_jit(("trace", funcs, base.shape, str(base.dtype),
+                          split, offset, axis1, axis2, str(target), mesh),
+                         build)
+        return self._wrap(fn(_check_live(base)), new_split)
+
+    def nonzero(self):
+        """Indices of non-zero elements as a tuple of host int64 arrays,
+        one per dimension — the plain-ndarray return the local backend
+        inherits.  Dynamic count → the two-phase pattern (SURVEY §7 hard
+        part 1): one compiled mask+count program, one scalar sync, then a
+        count-shaped gather; the host receives only the indices."""
+        mesh, split = self._mesh, self._split
+        base, funcs = self._chain_parts()
+
+        def count_build():
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                # canonical int: int64 under x64, so a >2**31 match
+                # count cannot wrap (x64-off cannot index past 2**31
+                # anyway — int32 indices are platform-wide there)
+                return jnp.sum(mapped != 0,
+                               dtype=jax.dtypes.canonicalize_dtype(np.int64))
+            return jax.jit(run)
+
+        k = int(jax.device_get(_cached_jit(
+            ("nonzero-count", funcs, base.shape, str(base.dtype), split,
+             mesh), count_build)(_check_live(base))))
+
+        def gather_build():
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                return jnp.nonzero(mapped, size=k)
+            return jax.jit(run)
+
+        out = jax.device_get(_cached_jit(
+            ("nonzero-gather", funcs, base.shape, str(base.dtype), split,
+             k, mesh), gather_build)(_check_live(base)))
+        return tuple(np.asarray(i).astype(np.int64) for i in out)
+
+    def searchsorted(self, v, side="left", sorter=None):
+        """Insertion points keeping this (1-d, sorted) array sorted —
+        computed on device, returned as host indices (the plain-ndarray
+        return the local backend inherits): a numpy int for scalar ``v``,
+        an int64 ndarray shaped like ``v`` otherwise."""
+        if self.ndim != 1:
+            raise ValueError("object too deep for desired array")
+        if side not in ("left", "right"):
+            raise ValueError(
+                "'%s' is an invalid value for keyword 'side'" % (side,))
+        from bolt_tpu.base import BoltArray
+        if isinstance(v, BoltArray):
+            v = v.tojax() if v.mode == "tpu" else np.asarray(v)
+        varr = v if isinstance(v, jax.Array) else np.asarray(v)
+        scalar = np.ndim(varr) == 0
+        if sorter is not None:
+            sorter = np.asarray(sorter)
+            if not np.issubdtype(sorter.dtype, np.integer):
+                # numpy's exact rejection — silent truncation would
+                # search a wrongly-permuted array
+                raise TypeError("sorter must only contain integers")
+            if sorter.shape != self.shape:
+                raise ValueError("sorter.size must equal a.size")
+        mesh, split = self._mesh, self._split
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data, vv, srt):
+                mapped = _chain_apply(funcs, split, data)
+                if srt is not None:
+                    mapped = jnp.take(mapped, srt, axis=0)
+                return jnp.searchsorted(mapped, vv, side=side)
+            return jax.jit(run)
+
+        fn = _cached_jit(("searchsorted", funcs, base.shape,
+                          str(base.dtype), split, side,
+                          sorter is not None, mesh), build)
+        srt = None if sorter is None else jnp.asarray(sorter, jnp.int32)
+        out = np.asarray(jax.device_get(fn(_check_live(base), varr, srt)))
+        out = out.astype(np.int64)
+        return out[()] if scalar else out
+
+    @property
+    def real(self):
+        """Real part (elementwise; defers and fuses like a map)."""
+        return self._unary(jnp.real)
+
+    @property
+    def imag(self):
+        """Imaginary part — zeros of the same dtype for real input, like
+        numpy (elementwise; defers and fuses like a map)."""
+        return self._unary(jnp.imag)
+
+    def conj(self):
+        """Elementwise complex conjugate (identity for real dtypes)."""
+        return self._unary(jnp.conj)
+
+    conjugate = conj
+
+    def set(self, index, value):
+        """Functional indexed update: a NEW array equal to this one with
+        ``self[index] = value`` applied — the cross-backend mutation
+        story (device arrays are immutable; ``__setitem__`` raises and
+        points here, and the local backend offers the same method).
+
+        Supports the same per-axis index forms as ``__getitem__``
+        (ints / slices / lists / 1-d int or bool arrays / one Ellipsis);
+        two or more advanced indices select ORTHOGONALLY, matching
+        ``__getitem__``.  ``value`` broadcasts against the selected
+        region and casts to this array's dtype (numpy assignment
+        semantics).  One compiled scatter program per index geometry."""
+        from bolt_tpu.utils import assignment_index, normalize_index
+        norm, squeezed = normalize_index(index, self.shape)
+        idx = assignment_index(norm, self.shape, squeezed)
+        from bolt_tpu.base import BoltArray
+        if isinstance(value, BoltArray):
+            value = value.tojax() if value.mode == "tpu" \
+                else np.asarray(value)
+        val = value if isinstance(value, jax.Array) else np.asarray(value)
+        # numpy assignment tolerates EXTRA leading length-1 dims on the
+        # value (relative to the region, which drops scalar-indexed
+        # axes); jax's scatter does not — squeeze them for parity
+        region_ndim = self.ndim - len(squeezed)
+        while val.ndim > region_ndim and val.shape[0] == 1:
+            val = val.reshape(val.shape[1:])
+        arrays = {ax: jnp.asarray(a) for ax, a in enumerate(idx)
+                  if isinstance(a, np.ndarray)}
+        static = tuple(None if isinstance(s, np.ndarray) else s
+                       for s in idx)
+        mesh, split = self._mesh, self._split
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data, v, iarrs):
+                mapped = _chain_apply(funcs, split, data)
+                full = tuple(iarrs[ax] if ax in iarrs else s
+                             for ax, s in enumerate(static))
+                out = mapped.at[full].set(v.astype(mapped.dtype))
+                return _constrain(out, mesh, split)
+            return jax.jit(run)
+
+        key = ("set", funcs, base.shape, str(base.dtype), split,
+               tuple((s.start, s.stop, s.step) if isinstance(s, slice)
+                     else s for s in static),
+               tuple((ax, a.shape) for ax, a in sorted(arrays.items())),
+               tuple(val.shape), str(val.dtype), mesh)
+        out = _cached_jit(key, build)(_check_live(base), val, arrays)
+        return self._wrap(out, split)
+
+    def __setitem__(self, index, value):
+        raise TypeError(
+            "'%s' does not support item assignment: device arrays are "
+            "immutable.  Use b = b.set(index, value) for a functional "
+            "update with the same indexing semantics (the local backend "
+            "offers the same method)" % type(self).__name__)
+
+    def item(self, *args):
+        """Copy the selected element to a Python scalar (ndarray
+        semantics: no args require size 1, one int is a flat index,
+        ``ndim`` ints are per-axis — negatives wrap).  ONE element is
+        gathered on device and fetched — never the array (one tiny
+        compiled program per distinct index; a static index keeps GSPMD
+        from all-gathering the sharded operand)."""
+        from numbers import Integral
+        if len(args) == 1 and isinstance(args[0], tuple):
+            args = args[0]
+        if not all(isinstance(a, Integral) for a in args):
+            raise TypeError("item() takes integer arguments")
+        if not args:
+            if prod(self.shape) != 1:
+                raise ValueError(
+                    "can only convert an array of size 1 to a Python "
+                    "scalar")
+            multi = (0,) * self.ndim
+        elif len(args) == 1:
+            flat = int(args[0])
+            size = prod(self.shape)
+            if flat < 0:
+                flat += size
+            if not 0 <= flat < size:
+                raise IndexError(
+                    "index %d is out of bounds for size %d"
+                    % (int(args[0]), size))
+            multi = tuple(int(i) for i in
+                          np.unravel_index(flat, self.shape)) \
+                if self.ndim else ()
+        else:
+            if len(args) != self.ndim:
+                raise ValueError("incorrect number of indices for array")
+            multi = []
+            for a, dim in zip(args, self.shape):
+                i = int(a)
+                if i < 0:
+                    i += dim
+                if not 0 <= i < dim:
+                    raise IndexError(
+                        "index %d is out of bounds for axis of size %d"
+                        % (int(a), dim))
+                multi.append(i)
+            multi = tuple(multi)
+        mesh, split = self._mesh, self._split
+        base, funcs = self._chain_parts()
+
+        def build():
+            def run(data):
+                mapped = _chain_apply(funcs, split, data)
+                return mapped[multi]
+            return jax.jit(run)
+
+        out = _cached_jit(("item", funcs, base.shape, str(base.dtype),
+                           split, multi, mesh), build)(_check_live(base))
+        return np.asarray(jax.device_get(out)).item()
+
+    def tolist(self):
+        """Nested Python lists of the gathered array (ndarray
+        semantics: a FULL host gather — size-bound like toarray)."""
+        return self.toarray().tolist()
 
     # In-place operators: jax arrays are immutable, so these are the
     # functional rebinding form (``b += 1`` rebinds ``b`` to a new array;
@@ -1664,8 +2044,32 @@ class BoltArrayTPU(BoltArray):
         return out
 
     def __array__(self, dtype=None):
+        from bolt_tpu.tpu.npdispatch import implicit_gather_warning
+        implicit_gather_warning(self.size * self.dtype.itemsize)
         a = self.toarray()
         return a.astype(dtype) if dtype is not None else a
+
+    def __array_function__(self, func, types, args, kwargs):
+        """Non-ufunc numpy API (``np.sum(b)``, ``np.concatenate``, …)
+        with NUMPY semantics, served on device by
+        :mod:`bolt_tpu.tpu.npdispatch` where the table covers it (result
+        comes back as a bolt array, zero host transfer) and by an
+        explicit host fallback — which warns above a size threshold —
+        otherwise.  The local backend gets the same API natively from
+        ndarray (VERDICT r2 missing-3)."""
+        from bolt_tpu.tpu import npdispatch
+        return npdispatch.dispatch(self, func, types, args, kwargs)
+
+    def _clone(self):
+        """A new wrapper over the same (immutable) device state — the
+        cheap copy behind functional forms of the in-place methods
+        (``np.sort``)."""
+        b = BoltArrayTPU(self._concrete, self._split, self._mesh)
+        b._chain = self._chain
+        b._pending = self._pending
+        b._donated = self._donated
+        b._aval = self._aval
+        return b
 
     def tolocal(self):
         from bolt_tpu.local.array import BoltArrayLocal
@@ -1688,6 +2092,54 @@ class BoltArrayTPU(BoltArray):
         ``BoltArraySpark.first`` — a one-record job; here one block
         transfer)."""
         return np.asarray(jax.device_get(self._data[(0,) * self._split]))
+
+    def _concat_many(self, others, axis):
+        """Concatenate with any number of operands in ONE compiled
+        program (``np.concatenate``'s dispatch target — the pairwise
+        method would materialise n−1 intermediates).  ``axis=None``
+        ravels every operand first, like numpy (result gets the flat
+        key axis)."""
+        parts = [self]
+        for a in others:
+            if isinstance(a, BoltArrayTPU):
+                self._check_mesh(a, "concatenate")
+                parts.append(a)
+            elif isinstance(a, BoltArray):
+                parts.append(jnp.asarray(a.toarray()))
+            else:
+                parts.append(self._coerce_operand(a))
+        if axis is not None:
+            axis = int(axis)
+            for p in parts:
+                if p.ndim != self.ndim:
+                    raise ValueError(
+                        "cannot concatenate %d-d with %d-d array"
+                        % (self.ndim, p.ndim))
+        mesh, split = self._mesh, self._split
+        new_split = split if axis is not None else (1 if split else 0)
+        # deferred chains on bolt operands fuse into the one program
+        chains = [p._chain_parts() if isinstance(p, BoltArrayTPU)
+                  else (p, None) for p in parts]
+        splits = [p._split if isinstance(p, BoltArrayTPU) else None
+                  for p in parts]
+
+        def build():
+            def cat(datas):
+                mapped = [_chain_apply(f, s, d) if f is not None else d
+                          for d, (_, f), s in zip(datas, chains, splits)]
+                if axis is None:
+                    mapped = [m.reshape(-1) for m in mapped]
+                out = jnp.concatenate(mapped, axis=0 if axis is None
+                                      else axis)
+                return _constrain(out, mesh, new_split)
+            return jax.jit(cat)
+
+        key = ("concat", axis, mesh,
+               tuple((b.shape, str(b.dtype), f, s)
+                     for (b, f), s in zip(chains, splits)))
+        out = _cached_jit(key, build)(
+            [_check_live(b) for b, _ in chains])
+        return self._wrap(out, new_split)
 
     def concatenate(self, arry, axis=0):
         """Concatenate along ``axis`` with another bolt array or ndarray

@@ -272,6 +272,41 @@ def check_value_shape(hint, inferred):
                          % (tuple(tupleize(hint)), tuple(inferred)))
 
 
+def assignment_index(norm, shape, squeezed=()):
+    """Index tuple that ASSIGNS to the region a ``__getitem__`` with the
+    same index would READ — valid for numpy in-place assignment and
+    jax's ``.at[...]`` alike, so the value broadcasts against exactly
+    the getitem result shape on both backends.
+
+    Scalar-indexed axes (``squeezed``) become bare ints: they drop out
+    of the region like numpy assignment (keeping them as length-1 dims
+    would reject a value shaped like the getitem result whenever a
+    non-1 dim precedes the scalar axis).  When the index is basic, or a
+    single advanced entry with no scalars alongside, the zipped and
+    orthogonal conventions coincide and the normalized entries pass
+    through (cheap basic/single-gather scatter).  Otherwise EVERY
+    non-scalar axis opens into an ``np.ix_``-style broadcast mesh: all
+    entries are then advanced and adjacent under numpy's rules (scalars
+    are 0-d advanced), so region dims follow axis order — the
+    orthogonal cross product, matching ``__getitem__``.  Shared by both
+    backends' ``set``/``__setitem__`` so the semantics cannot drift."""
+    arrays = [s for s in norm if isinstance(s, np.ndarray)]
+    if len(arrays) <= 1 and not (arrays and squeezed):
+        return tuple(int(s.start) if ax in squeezed else s
+                     for ax, s in enumerate(norm))
+    meshed = [ax for ax in range(len(norm)) if ax not in squeezed]
+    k = len(meshed)
+    out = []
+    for ax, (s, dim) in enumerate(zip(norm, shape)):
+        if ax in squeezed:
+            out.append(int(s.start))
+            continue
+        a = np.arange(dim)[s] if isinstance(s, slice) else s
+        pos = meshed.index(ax)
+        out.append(a.reshape((1,) * pos + (a.size,) + (1,) * (k - pos - 1)))
+    return tuple(out)
+
+
 def check_q(q):
     """Validate a quantile ``q`` (scalar or 1-d, every value in [0, 1])
     and return it as a float64 ndarray — shared by both backends so the

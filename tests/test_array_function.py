@@ -1,0 +1,247 @@
+"""``__array_function__``: the non-ufunc numpy API on the TPU backend —
+device-served with NUMPY semantics where the dispatch table covers it,
+explicit (warned) host fallback otherwise (VERDICT r2 missing-3).  The
+local backend is the oracle: it IS an ndarray, so plain numpy defines
+every expected value."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.tpu import npdispatch
+
+
+def _x():
+    return np.random.RandomState(31).randn(16, 6, 4)
+
+
+# (name, call) — run against the TPU bolt array; expectation is the same
+# call on the raw numpy array (numpy semantics, not bolt's key-axis
+# defaults)
+DEVICE_CASES = [
+    ("sum", lambda a: np.sum(a)),
+    ("sum-axis", lambda a: np.sum(a, axis=1)),
+    ("sum-keepdims", lambda a: np.sum(a, axis=(0, 2), keepdims=True)),
+    ("prod", lambda a: np.prod(a / 2)),
+    ("mean", lambda a: np.mean(a)),
+    ("var", lambda a: np.var(a)),
+    ("var-ddof", lambda a: np.var(a, ddof=1)),
+    ("std-axis", lambda a: np.std(a, axis=0)),
+    ("min", lambda a: np.min(a)),
+    ("amax", lambda a: np.amax(a, axis=2)),
+    ("ptp", lambda a: np.ptp(a, axis=1)),
+    ("all", lambda a: np.all(a > -99)),
+    ("any", lambda a: np.any(a > 1, axis=0)),
+    ("cumsum", lambda a: np.cumsum(a)),
+    ("cumsum-axis", lambda a: np.cumsum(a, axis=1)),
+    ("cumprod-axis", lambda a: np.cumprod(a, axis=2)),
+    ("argmax", lambda a: np.argmax(a)),
+    ("argmin-axis", lambda a: np.argmin(a, axis=1)),
+    ("quantile", lambda a: np.quantile(a, 0.3)),
+    ("quantile-vector", lambda a: np.quantile(a, [0.2, 0.8], axis=0)),
+    ("percentile", lambda a: np.percentile(a, 75)),
+    ("median", lambda a: np.median(a)),
+    ("median-axis", lambda a: np.median(a, axis=1)),
+    ("sort", lambda a: np.sort(a, axis=0)),
+    ("sort-flat", lambda a: np.sort(a, axis=None)),
+    ("argsort", lambda a: np.argsort(a, axis=2, kind="stable")),
+    ("take", lambda a: np.take(a, [3, 1], axis=0)),
+    ("take-flat", lambda a: np.take(a, [5, 0, 17])),
+    ("repeat", lambda a: np.repeat(a, 2, axis=1)),
+    ("nonzero", lambda a: np.nonzero(a > 1.5)),
+    ("ravel", lambda a: np.ravel(a)),
+    ("transpose", lambda a: np.transpose(a, (0, 2, 1))),
+    ("squeeze", lambda a: np.squeeze(a[0:1])),
+    ("swapaxes", lambda a: np.swapaxes(a, 1, 2)),
+    ("clip", lambda a: np.clip(a, -0.5, 0.5)),
+    ("round", lambda a: np.round(a, 1)),
+    ("real", lambda a: np.real(a)),
+    ("imag", lambda a: np.imag(a)),
+    ("diagonal", lambda a: np.diagonal(a, 0, 1, 2)),
+    ("trace", lambda a: np.trace(a, 0, 1, 2)),
+    ("searchsorted", lambda a: np.searchsorted(a, [0.0, 0.5])),
+]
+
+
+@pytest.mark.parametrize("name,call", DEVICE_CASES,
+                         ids=[c[0] for c in DEVICE_CASES])
+def test_numpy_semantics_parity(mesh, name, call):
+    x = _x()
+    if name == "searchsorted":
+        x = np.sort(x.ravel())
+    b = bolt.array(x, mesh)
+    expect = call(x)
+    got = call(b)
+
+    def norm(v):
+        if isinstance(v, tuple):
+            return tuple(np.asarray(i) for i in v)
+        return np.asarray(v.toarray() if hasattr(v, "toarray") else v)
+
+    g, e = norm(got), norm(expect)
+    if isinstance(e, tuple):
+        assert all(np.array_equal(a, b_) for a, b_ in zip(g, e)), name
+    else:
+        assert g.shape == e.shape, (name, g.shape, e.shape)
+        assert np.allclose(g, e, equal_nan=True), name
+
+
+def test_device_served_no_gather(mesh, monkeypatch):
+    # the acceptance check: np.sum(b) runs ON DEVICE — no toarray, no
+    # __array__, and instrument() shows the stat-family program running
+    import bolt_tpu.profile as profile
+    x = _x()
+    b = bolt.array(x, mesh)
+    monkeypatch.setattr(
+        type(b), "toarray",
+        lambda self: (_ for _ in ()).throw(AssertionError("gathered!")))
+    monkeypatch.setattr(
+        type(b), "__array__",
+        lambda self, dtype=None: (_ for _ in ()).throw(
+            AssertionError("implicit __array__!")))
+    with profile.instrument() as stats:
+        out = np.sum(b)
+        np.mean(b, axis=0)
+        np.sort(b, axis=1)
+        np.concatenate([b, b], axis=2)
+    assert out.mode == "tpu" and out.split == 0
+    assert stats.get("stat", {}).get("calls", 0) >= 2
+    assert stats.get("sort", {}).get("calls", 0) == 1
+    assert stats.get("concat", {}).get("calls", 0) == 1
+
+
+def test_np_sort_functional_does_not_mutate(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    s = np.sort(b, axis=0)
+    assert np.allclose(b.toarray(), x)              # original untouched
+    assert np.allclose(s.toarray(), np.sort(x, axis=0))
+    # deferred chain: np.sort of a mapped array leaves the map intact
+    m = bolt.array(x, mesh).map(lambda v: v * 2)
+    s2 = np.sort(m, axis=0)
+    assert np.allclose(s2.toarray(), np.sort(x * 2, axis=0))
+    assert np.allclose(m.toarray(), x * 2)
+
+
+def test_concatenate_mixed_operands(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    lo = bolt.array(x)
+    # device-first: stays on device
+    out = np.concatenate([b, lo, x], axis=0)
+    assert hasattr(out, "mode") and out.mode == "tpu"
+    assert np.allclose(out.toarray(), np.concatenate([x, x, x], axis=0))
+    # host-first: falls back to plain numpy (host result)
+    out2 = np.concatenate([x, b], axis=0)
+    assert isinstance(out2, np.ndarray)
+    assert np.allclose(out2, np.concatenate([x, x], axis=0))
+
+
+def test_concatenate_axis_none_and_one_program(mesh):
+    # axis=None flattens every operand, like numpy — including mixed
+    # ranks and split>1 (r3 review finding: this used to crash)
+    x = _x()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    out = np.concatenate([b, b], axis=None)
+    assert np.allclose(out.toarray(), np.concatenate([x, x], axis=None))
+    assert out.split == 1
+    mixed = np.concatenate([b, bolt.array(x[0, 0], mesh)], axis=None)
+    assert np.allclose(mixed.toarray(),
+                       np.concatenate([x, x[0, 0]], axis=None))
+    # n operands are ONE compiled program, not n-1 pairwise copies
+    from bolt_tpu.tpu import array as array_mod
+    b1 = bolt.array(x, mesh)
+    n_before = sum(1 for k in array_mod._JIT_CACHE if k[0] == "concat")
+    out = np.concatenate([b1, b1, b1, b1], axis=1)
+    assert np.allclose(out.toarray(), np.concatenate([x] * 4, axis=1))
+    assert sum(1 for k in array_mod._JIT_CACHE
+               if k[0] == "concat") == n_before + 1
+
+
+class _Duck:
+    """A foreign duck array implementing __array_function__."""
+
+    def __array_function__(self, func, types, args, kwargs):
+        return "duck-served"
+
+
+def test_nep18_defers_to_unknown_duck_types(mesh):
+    # an operand type we don't recognize gets NotImplemented so ITS
+    # handler runs (r3 review finding: bolt used to hijack the call)
+    b = bolt.array(_x(), mesh)
+    assert np.concatenate([b, _Duck()]) == "duck-served"
+
+
+def test_searchsorted_rejects_float_sorter(mesh):
+    x = np.sort(np.random.RandomState(13).randn(8))
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        with pytest.raises(TypeError, match="integer"):
+            b.searchsorted(0.0, sorter=np.array([0.2, 2.9, 1.5, 0, 1, 2, 3, 4]))
+
+
+def test_unsupported_kwargs_fall_back_correctly(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    # out= cannot be served on device; host fallback still honours it
+    out = np.zeros(())
+    np.sum(b, out=out)
+    assert np.allclose(out, x.sum())
+    # dtype= falls back and matches numpy exactly
+    assert np.allclose(np.sum(b, dtype=np.float32), x.sum(dtype=np.float32))
+    # unhandled function (np.stack) → host path, numpy result
+    st = np.stack([b, b])
+    assert isinstance(st, np.ndarray)
+    assert np.allclose(st, np.stack([x, x]))
+
+
+def test_implicit_gather_warns_once_above_threshold(mesh, monkeypatch):
+    x = _x()
+    b = bolt.array(x, mesh)
+    monkeypatch.setattr(npdispatch, "IMPLICIT_GATHER_WARN_BYTES", 64)
+    monkeypatch.setattr(npdispatch, "_warned", [False])
+    with pytest.warns(UserWarning, match="implicitly gathered"):
+        np.asarray(b)
+    # once per session: the second gather is silent
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        np.asarray(b)
+    # explicit toarray never warns
+    monkeypatch.setattr(npdispatch, "_warned", [False])
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        b.toarray()
+
+
+def test_small_gather_is_silent(mesh, monkeypatch):
+    monkeypatch.setattr(npdispatch, "_warned", [False])
+    b = bolt.array(_x(), mesh)          # ~3 KB << threshold
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        np.asarray(b)
+
+
+def test_shape_ndim_size(mesh):
+    b = bolt.array(_x(), mesh)
+    assert np.shape(b) == (16, 6, 4)
+    assert np.ndim(b) == 3
+    assert np.size(b) == 384
+    assert np.size(b, 1) == 6
+
+
+def test_np_unique_and_dot(mesh):
+    x = np.floor(_x() * 2)
+    b = bolt.array(x, mesh)
+    u, c = np.unique(b, return_counts=True)
+    un, cn = np.unique(x, return_counts=True)
+    assert np.array_equal(u, un) and np.array_equal(c, cn)
+    # unsupported unique options take the host path, same answer
+    u2, inv = np.unique(b, return_inverse=True)
+    un2, invn = np.unique(x, return_inverse=True)
+    assert np.array_equal(u2, un2) and np.array_equal(inv, invn)
+    # np.dot with a device left operand stays on device
+    w = np.random.RandomState(3).randn(4, 2)
+    d = np.dot(b, w)
+    assert hasattr(d, "mode") and d.mode == "tpu"
+    assert np.allclose(d.toarray(), x @ w)

@@ -1,0 +1,299 @@
+"""The inherited-ndarray method surface (VERDICT r2 missing-2).
+
+The local backend gets ``sort``/``ravel``/``repeat``/``diagonal``/
+``trace``/``nonzero``/``searchsorted``/``real``/``imag``/``conj`` (and
+in-place ``__setitem__``) for free from ``numpy.ndarray``; the TPU
+backend implements the same surface natively, plus the shared functional
+``set``.  This suite ENUMERATES the methods and asserts
+same-result-or-same-error on both backends (reference:
+``bolt/local/array.py`` — the ndarray subclass; symbol cite, SURVEY §0).
+"""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+
+
+def _f():
+    return np.random.RandomState(7).randn(6, 4, 5)
+
+
+def _i():
+    return np.random.RandomState(8).randint(-3, 4, size=(6, 4, 5))
+
+
+def _i8():
+    return np.random.RandomState(9).randint(0, 3, size=(6, 4, 5)).astype(np.int8)
+
+
+def _c():
+    rs = np.random.RandomState(10)
+    return (rs.randn(6, 4, 5) + 1j * rs.randn(6, 4, 5))
+
+
+def _s():                           # sorted 1-d, for searchsorted
+    return np.sort(np.random.RandomState(11).randn(24))
+
+
+def _sort(axis=-1, kind=None):
+    def fn(b):
+        assert b.sort(axis=axis, kind=kind) is None   # ndarray convention
+        return b
+    return fn
+
+
+# (name, array builder, method call) — every entry runs on BOTH backends
+# and must produce the same value/shape/dtype or raise the same error
+CASES = [
+    ("sort", _f, _sort()),
+    ("sort-axis0", _f, _sort(axis=0)),
+    ("sort-stable", _i, _sort(kind="stable")),
+    ("sort-bad-kind", _f, _sort(kind="bogus")),
+    ("sort-axis-none", _f, _sort(axis=None)),
+    ("sort-axis-oob", _f, _sort(axis=7)),
+    ("ravel", _f, lambda b: b.ravel()),
+    ("ravel-F", _f, lambda b: b.ravel("F")),
+    ("ravel-A", _f, lambda b: b.ravel("A")),
+    ("flatten", _i, lambda b: b.flatten()),
+    ("flatten-F", _i, lambda b: b.flatten("F")),
+    ("repeat-scalar", _f, lambda b: b.repeat(3)),
+    ("repeat-axis", _f, lambda b: b.repeat(2, axis=1)),
+    ("repeat-axis-neg", _f, lambda b: b.repeat(2, axis=-1)),
+    ("repeat-array", _f, lambda b: b.repeat([2, 0, 1, 3], axis=1)),
+    ("repeat-size1-array", _f, lambda b: b.repeat([2], axis=1)),
+    ("repeat-float-truncates", _i, lambda b: b.repeat(2.7, axis=0)),
+    ("repeat-negative", _f, lambda b: b.repeat(-1)),
+    ("repeat-len-mismatch", _f, lambda b: b.repeat([1, 2], axis=0)),
+    ("repeat-2d", _f, lambda b: b.repeat(np.ones((1, 6), int), axis=0)),
+    ("diagonal", _f, lambda b: b.diagonal()),
+    ("diagonal-offset", _f, lambda b: b.diagonal(1)),
+    ("diagonal-offset-neg", _f, lambda b: b.diagonal(-2)),
+    ("diagonal-value-axes", _f, lambda b: b.diagonal(0, 1, 2)),
+    ("diagonal-kv-axes", _f, lambda b: b.diagonal(0, 0, 2)),
+    ("diagonal-same-axis", _f, lambda b: b.diagonal(0, 1, 1)),
+    ("trace", _f, lambda b: b.trace()),
+    ("trace-offset", _f, lambda b: b.trace(1)),
+    ("trace-int8-promotes", _i8, lambda b: b.trace()),
+    ("trace-dtype-arg", _i, lambda b: b.trace(dtype=np.float64)),
+    ("nonzero-int", _i, lambda b: b.nonzero()),
+    ("nonzero-float", _f, lambda b: b.nonzero()),
+    ("searchsorted-scalar", _s, lambda b: b.searchsorted(0.0)),
+    ("searchsorted-array", _s,
+     lambda b: b.searchsorted(np.linspace(-2, 2, 7))),
+    ("searchsorted-right", _s,
+     lambda b: b.searchsorted(np.linspace(-2, 2, 7), side="right")),
+    ("searchsorted-2d-v", _s,
+     lambda b: b.searchsorted(np.zeros((2, 3)))),
+    ("searchsorted-bad-side", _s, lambda b: b.searchsorted(0.0, side="up")),
+    ("searchsorted-2d-self", _f, lambda b: b.searchsorted(0.0)),
+    ("real-float", _f, lambda b: b.real),
+    ("imag-float", _f, lambda b: b.imag),
+    ("real-complex", _c, lambda b: b.real),
+    ("imag-complex", _c, lambda b: b.imag),
+    ("conj-complex", _c, lambda b: b.conj()),
+    ("conjugate-float", _f, lambda b: b.conjugate()),
+    ("conj-int", _i, lambda b: b.conj()),
+    ("set-slice", _f, lambda b: b.set(np.s_[1:3], 0.5)),
+    ("set-int", _f, lambda b: b.set(2, 7.0)),
+    ("set-neg-int", _f, lambda b: b.set(-1, 7.0)),
+    ("set-ellipsis", _f, lambda b: b.set(np.s_[..., 2], -1.0)),
+    ("set-list", _f, lambda b: b.set(([4, 0, 2],), 9.0)),
+    ("set-array-value", _f,
+     lambda b: b.set(np.s_[1:3, 2], np.arange(5.0))),
+    ("set-cast-truncates", _i, lambda b: b.set(0, 2.9)),
+    ("set-bool-mask", _f,
+     lambda b: b.set((np.arange(6) % 2 == 0,), 0.0)),
+    ("set-orthogonal", _f,
+     lambda b: b.set(([0, 2], slice(None), [1, 3]),
+                     np.arange(2 * 4 * 2.0).reshape(2, 4, 2))),
+    ("set-extra-leading-1s", _f,
+     lambda b: b.set(1, np.ones((1, 1, 4, 5)))),
+    ("set-bad-broadcast", _f, lambda b: b.set(1, np.zeros((3, 5)))),
+    ("set-oob", _f, lambda b: b.set(99, 0.0)),
+    ("set-scalar-after-advanced", _f,
+     lambda b: b.set(([0, 1], 2), np.arange(5.0))),
+    ("set-advanced-after-scalar", _f,
+     lambda b: b.set((2, [1, 3]), np.arange(5.0) + 1)),
+    ("item", _f, lambda b: b.item(3)),
+    ("item-neg", _f, lambda b: b.item(-1)),
+    ("item-multi", _f, lambda b: b.item(1, 2, 3)),
+    ("item-tuple", _f, lambda b: b.item((1, 2, 3))),
+    ("item-oob", _f, lambda b: b.item(10 ** 6)),
+    ("item-not-size1", _f, lambda b: b.item()),
+    ("tolist", _i, lambda b: b.tolist()),
+]
+
+
+def _run(fn, b):
+    try:
+        return ("ok", fn(b))
+    except Exception as exc:                      # noqa: BLE001
+        return ("err", type(exc))
+
+
+def _assert_same(name, lo, tp):
+    if isinstance(lo, tuple):
+        assert isinstance(tp, tuple) and len(lo) == len(tp), name
+        for a, b in zip(lo, tp):
+            _assert_same(name, a, b)
+        return
+    if isinstance(lo, list) or lo is None or np.isscalar(lo):
+        assert np.array_equal(np.asarray(lo), np.asarray(tp)), name
+        return
+    an, bn = np.asarray(lo), np.asarray(tp)
+    assert an.shape == bn.shape, (name, an.shape, bn.shape)
+    assert an.dtype == bn.dtype, (name, an.dtype, bn.dtype)
+    assert np.allclose(an, bn, equal_nan=True), name
+
+
+@pytest.mark.parametrize("name,make,fn", CASES, ids=[c[0] for c in CASES])
+def test_method_parity(mesh, name, make, fn):
+    x = make()
+    lo_status, lo = _run(fn, bolt.array(x.copy()))
+    tp_status, tp = _run(fn, bolt.array(x.copy(), mesh))
+    assert lo_status == tp_status, (name, lo, tp)
+    if lo_status == "err":
+        # same-error: identical class, or one a subclass of the other
+        # (e.g. np.AxisError IS a ValueError)
+        assert lo is tp or issubclass(tp, lo) or issubclass(lo, tp), \
+            (name, lo, tp)
+    else:
+        _assert_same(name, lo, tp)
+
+
+def test_sort_matches_numpy(mesh):
+    x = _f()
+    b = bolt.array(x, mesh)
+    assert b.sort(axis=0) is None
+    assert np.array_equal(b.toarray(), np.sort(x, axis=0))
+    # sorting a deferred chain materialises the fused chain, sorted
+    m = bolt.array(x, mesh).map(lambda v: v * -1)
+    m.sort()
+    assert np.allclose(m.toarray(), np.sort(-x, axis=-1))
+
+
+def test_set_does_not_mutate(mesh):
+    x = _f()
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        out = b.set(0, 0.0)
+        assert np.allclose(b.toarray(), x), b.mode        # original intact
+        assert np.allclose(np.asarray(out.toarray())[0], 0.0)
+        assert out.shape == x.shape
+    t = bolt.array(x, mesh).set(0, 0.0)
+    assert t.split == 1
+
+
+def test_setitem_tpu_raises_pointing_to_set(mesh):
+    b = bolt.array(_f(), mesh)
+    with pytest.raises(TypeError, match="set"):
+        b[0] = 1.0
+
+
+def test_setitem_local_orthogonal_matches_set(mesh):
+    # >=2 advanced indices: in-place assignment covers the ORTHOGONAL
+    # region, same as set() and __getitem__ on both backends
+    x = _f()
+    lo = bolt.array(x.copy())
+    lo[[0, 2], :, [1, 3]] = -5.0
+    via_set = bolt.array(x).set(([0, 2], slice(None), [1, 3]), -5.0)
+    assert np.allclose(np.asarray(lo), np.asarray(via_set.toarray()))
+    tpu_set = bolt.array(x, mesh).set(([0, 2], slice(None), [1, 3]), -5.0)
+    assert np.allclose(np.asarray(lo), tpu_set.toarray())
+    # the region is the cross product: exactly those 2*4*2 entries changed
+    changed = np.asarray(lo) != x
+    assert changed.sum() == 2 * 4 * 2
+    # single advanced index keeps numpy's (identical) semantics
+    lo2 = bolt.array(x.copy())
+    lo2[[1, 3]] = 0.0
+    assert np.allclose(np.asarray(lo2)[[1, 3]], 0.0)
+
+
+def test_set_getitem_roundtrip(mesh):
+    # the region set() assigns is the region __getitem__ reads: writing a
+    # value shaped exactly like b[idx] always succeeds — including
+    # scalar-mixed-with-advanced indices, where keeping the scalar axis
+    # as a length-1 dim would reject it (r3 review finding)
+    x = _f()
+    for idx in [np.s_[1:3], (2,), ([0, 1], 2), (2, [1, 3]),
+                ([0, 2], slice(None), [1, 3]), (slice(None), 1, [0, 4]),
+                np.s_[..., 2], ([4, 0], 1, 2)]:
+        for b in (bolt.array(x), bolt.array(x, mesh)):
+            region = np.asarray(b[idx].toarray())
+            out = b.set(idx, region * 0 - 1.0)
+            changed = np.asarray(out.toarray()) != x
+            assert changed.sum() == region.size, (b.mode, idx)
+            # and the round-trip restores the original exactly
+            back = out.set(idx, region)
+            assert np.allclose(back.toarray(), x), (b.mode, idx)
+
+
+def test_item_fetches_one_element_not_the_array(mesh, monkeypatch):
+    # item() gathers ONE element on device; the full array never moves
+    # (r3 review finding: it used to route through toarray())
+    x = _f()
+    b = bolt.array(x, mesh)
+    called = []
+    monkeypatch.setattr(type(b), "toarray",
+                        lambda self: called.append(1) or x)
+    assert abs(b.item(3) - x.reshape(-1)[3]) < 1e-12
+    assert abs(b.item(1, 2, 3) - x[1, 2, 3]) < 1e-12
+    assert not called
+    # size-1 no-arg form
+    one = bolt.array(np.full((1, 1), 42.0), mesh)
+    assert one.item() == 42.0
+
+
+def test_nonzero_two_phase_and_values(mesh):
+    x = np.zeros((5, 4))
+    x[1, 2] = 3.0
+    x[4, 0] = -1.0
+    t = bolt.array(x, mesh).nonzero()
+    expect = x.nonzero()
+    assert len(t) == 2
+    for a, b in zip(t, expect):
+        assert a.dtype == np.int64
+        assert np.array_equal(a, b)
+    # a deferred chain fuses into both phases
+    m = bolt.array(x, mesh).map(lambda v: v * 0 + (v > 2))
+    got = m.nonzero()
+    want = (x > 2).nonzero()
+    for a, b in zip(got, want):
+        assert np.array_equal(a, b)
+
+
+def test_searchsorted_sorter(mesh):
+    x = np.random.RandomState(12).randn(16)
+    order = np.argsort(x)
+    v = np.linspace(-1, 1, 5)
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        got = b.searchsorted(v, sorter=order)
+        assert np.array_equal(np.asarray(got), np.searchsorted(x, v, sorter=order)), b.mode
+    with pytest.raises(ValueError):
+        bolt.array(x, mesh).searchsorted(0.0, sorter=np.arange(3))
+
+
+def test_repeat_split_and_chain(mesh):
+    x = _f()
+    # axis=None flattens: flat key axis (filter's convention)
+    t = bolt.array(x, mesh).repeat(2)
+    assert t.split == 1 and t.shape == (x.size * 2,)
+    # key-axis repeat keeps the split
+    t = bolt.array(x, mesh).repeat(3, axis=0)
+    assert t.split == 1 and t.shape == (18, 4, 5)
+    # deferred chain fuses in
+    m = bolt.array(x, mesh).map(lambda v: v + 1).repeat(2, axis=2)
+    assert np.allclose(m.toarray(), (x + 1).repeat(2, axis=2))
+
+
+def test_ravel_and_diagonal_splits(mesh):
+    x = _f()
+    b = bolt.array(x, mesh, axis=(0, 1))
+    r = b.ravel()
+    assert r.split == 1 and np.allclose(r.toarray(), x.ravel())
+    d = b.diagonal(0, 0, 2)          # one key + one value axis removed
+    assert d.split == 1
+    assert np.allclose(d.toarray(), x.diagonal(0, 0, 2))
+    tr = b.trace(0, 0, 1)            # both key axes reduced
+    assert tr.split == 0
+    assert np.allclose(tr.toarray(), x.trace(0, 0, 1))
