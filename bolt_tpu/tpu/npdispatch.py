@@ -169,6 +169,10 @@ def _argmin(a, axis=None, out=None, keepdims=_NV):
 # ---------------------------------------------------------------------
 
 def _quantile_call(a, q, axis, method, keepdims):
+    if method not in ("linear", "lower", "higher", "midpoint", "nearest"):
+        # numpy's other estimators (inverted_cdf, median_unbiased, ...)
+        # are not in jnp.quantile — serve them on the host path
+        raise _Fallback("method")
     return a.quantile(q, axis=_all_axes(a, axis), method=method,
                       keepdims=_keepdims(keepdims))
 
