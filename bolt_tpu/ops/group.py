@@ -155,6 +155,30 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
     return BoltArrayTPU(out, 1, mesh)
 
 
+def _topk_desc(xp, moved, k):
+    """Largest ``k`` along the LAST axis, descending, with
+    ``lax.top_k``'s exact tie/NaN semantics, for either array module
+    (``np`` on the oracle, ``jnp`` on device) — ONE algorithm on both
+    backends, and the formulation GSPMD partitions without gathering
+    (``lax.top_k`` itself all-gathers a sharded operand; a stable
+    argsort along an unsharded last axis is collective-free, and along
+    a sharded axis lowers to all-to-all — see tests/test_lowering.py).
+
+    Descending order WITHOUT negating (negation wraps unsigned/INT_MIN
+    and rejects bools): stable-ascending-argsort the index-reversed
+    array (ties there resolve to the HIGHER original index), map back,
+    reverse — descending, ties to the LOWER index, NaNs first
+    (largest)."""
+    L = moved.shape[-1]
+    if xp is np:
+        idx_rev = np.argsort(moved[..., ::-1], axis=-1, kind="stable")
+    else:
+        idx_rev = xp.argsort(moved[..., ::-1], axis=-1, stable=True)
+    desc = (L - 1 - idx_rev)[..., ::-1]
+    idx = desc[..., :k]
+    return xp.take_along_axis(moved, idx, axis=-1), idx
+
+
 def topk(b, k, axis=-1):
     """Largest ``k`` values (descending) and their indices along ``axis``
     — ``jax.lax.top_k`` semantics, one compiled program; returns
@@ -182,44 +206,40 @@ def topk(b, k, axis=-1):
     if b.mode == "local":
         x = np.asarray(b)
         moved = np.moveaxis(x, axis, -1)
-        # descending order with lax.top_k's tie/NaN semantics, WITHOUT
-        # negating (negation wraps unsigned/INT_MIN and rejects bools):
-        # stable-ascending-argsort the index-reversed array (ties there
-        # resolve to the HIGHER original index), map back, reverse —
-        # descending, ties to the LOWER index, NaNs first (largest)
-        L = moved.shape[-1]
-        idx_rev = np.argsort(moved[..., ::-1], axis=-1, kind="stable")
-        desc = (L - 1 - idx_rev)[..., ::-1]
-        idx = desc[..., :k]
-        vals = np.take_along_axis(moved, idx, axis=-1)
+        vals, idx = _topk_desc(np, moved, k)
         from bolt_tpu.local.array import BoltArrayLocal
         return (BoltArrayLocal(np.moveaxis(vals, -1, axis)),
                 BoltArrayLocal(np.moveaxis(idx, -1, axis)))
 
     from bolt_tpu.tpu.array import (_CHUNK_MAX_BYTES, BoltArrayTPU,
                                     _cached_jit, _chain_apply, _check_live,
-                                    _constrain)
+                                    _constrain, hbm_check)
     base, funcs = b._chain_parts()
     split = b.split
     mesh = b.mesh
     # the axis keeps its key/value role (its size becomes k; a
     # non-dividing key size just falls back to replication in the spec)
 
-    # memory model: a non-last ``axis`` needs a full transposed copy for
-    # lax.top_k; at HBM scale that copy is bounded by slabbing along
-    # another axis (outputs are k-sized along ``axis`` — small — so the
+    # memory model: _topk_desc materialises the (possibly transposed)
+    # operand, its reversed view, and an input-sized argsort index
+    # array; at HBM scale a non-last ``axis`` is bounded by slabbing
+    # along another axis (outputs are k-sized — small — so the
     # reassembly concatenate is cheap).  VERDICT r2 weak-4.
+    idx_item = np.dtype(jax.dtypes.canonicalize_dtype(np.int64)).itemsize
     in_bytes = int(np.prod(b.shape)) * np.dtype(b.dtype).itemsize
+    idx_bytes = int(np.prod(b.shape)) * idx_item
     if axis != ndim - 1 and in_bytes > _CHUNK_MAX_BYTES:
         out = _topk_chunked(b, k, axis, in_bytes)
         if out is not None:
             return out
+    hbm_check("topk", 2 * in_bytes + idx_bytes,
+              "input + reversed/transposed copy + argsort index array")
 
     def build():
         def run(data):
             x = _chain_apply(funcs, split, data)
             moved = jnp.moveaxis(x, axis, -1)
-            vals, idx = jax.lax.top_k(moved, k)
+            vals, idx = _topk_desc(jnp, moved, k)
             return (_constrain(jnp.moveaxis(vals, -1, axis), mesh, split),
                     _constrain(jnp.moveaxis(idx, -1, axis), mesh, split))
         return jax.jit(run)
@@ -239,11 +259,16 @@ def _topk_chunked(b, k, axis, in_bytes):
     import jax
     import jax.numpy as jnp
     from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _constrain,
-                                    slab_plan)
+                                    hbm_check, slab_plan)
     plan = slab_plan(b.shape, axis, in_bytes)
     if plan is None:
         return None
     cax, pairs = plan
+    slab_bytes = in_bytes // len(pairs)
+    idx_item = np.dtype(jax.dtypes.canonicalize_dtype(np.int64)).itemsize
+    hbm_check("topk", in_bytes + 2 * slab_bytes
+              + (slab_bytes // np.dtype(b.dtype).itemsize) * idx_item,
+              "input + per-slab transposed copy + per-slab argsort index")
     data = b._data                          # chain materialises once
     mesh, split = b.mesh, b.split
     parts = []
@@ -253,7 +278,7 @@ def _topk_chunked(b, k, axis, in_bytes):
             def run(d):
                 slab = jax.lax.slice_in_dim(d, s0, s1, axis=cax)
                 moved = jnp.moveaxis(slab, axis, -1)
-                vals, idx = jax.lax.top_k(moved, k)
+                vals, idx = _topk_desc(jnp, moved, k)
                 return (jnp.moveaxis(vals, -1, axis),
                         jnp.moveaxis(idx, -1, axis))
             return jax.jit(run)
@@ -309,46 +334,64 @@ def unique(b, return_counts=False):
     if n * np.dtype(b.dtype).itemsize > _CHUNK_MAX_BYTES:
         return _unique_chunked(b, return_counts)
 
-    def phase1_build():
-        def run(data):
-            flat = jnp.sort(_chain_apply(funcs, split, data).reshape(-1))
-            neq = flat[1:] != flat[:-1]
-            if jnp.issubdtype(flat.dtype, jnp.floating):
-                # numpy collapses NaNs to one entry; sorted NaNs are
-                # contiguous at the end, so "both NaN" marks duplicates
-                neq &= ~(jnp.isnan(flat[1:]) & jnp.isnan(flat[:-1]))
-            mask = jnp.concatenate([jnp.ones(1, bool), neq])
-            return flat, mask, jnp.sum(mask, dtype=jnp.int32)
-        return jax.jit(run)
-
     sorted_, mask, cnt = _cached_jit(
         ("unique-sort", funcs, base.shape, str(base.dtype), split, mesh),
-        phase1_build)(_check_live(base))
+        lambda: _unique_phase1(funcs, split, None, None))(_check_live(base))
     k = int(jax.device_get(cnt))               # the one unavoidable sync
-
-    def phase2_build():
-        def run(s, m):
-            idx = jnp.nonzero(m, size=k, fill_value=n)[0]
-            uniq = jnp.take(s, idx, axis=0, mode="clip")
-            if not return_counts:
-                return (uniq,)   # skip the counts work and their transfer
-            ends = jnp.concatenate(
-                [idx[1:], jnp.asarray([n], idx.dtype)])
-            # canonical int on device (int32 when x64 is off — no warning);
-            # the host result is widened to int64 after the fetch
-            return uniq, (ends - idx).astype(
-                jax.dtypes.canonicalize_dtype(np.int64))
-        return jax.jit(run)
 
     # n is the chain-OUTPUT element count (a shape-changing map can alter
     # it), so the key carries funcs and n like every other chain consumer
     out = jax.device_get(_cached_jit(
         ("unique-gather", funcs, base.shape, str(base.dtype), split, n, k,
-         return_counts, mesh), phase2_build)(sorted_, mask))
+         return_counts, mesh),
+        lambda: _unique_phase2(n, k, return_counts))(sorted_, mask))
     uniq = np.asarray(out[0])
     if return_counts:
         return uniq, np.asarray(out[1]).astype(np.int64)
     return uniq
+
+
+def _unique_phase1(funcs, split, start, stop):
+    """Phase-1 program: sort (a ``[start:stop)`` slice of) the flattened
+    chain output, first-occurrence mask — with numpy's NaN collapse:
+    sorted NaNs are contiguous at the end, so "both NaN" marks
+    duplicates — and the mask count.  ONE builder for the whole-array
+    and chunked paths, so the mask semantics cannot drift."""
+    import jax
+    import jax.numpy as jnp
+    from bolt_tpu.tpu.array import _chain_apply
+
+    def run(d):
+        flat = _chain_apply(funcs, split, d).reshape(-1)
+        if start is not None:
+            flat = jax.lax.slice_in_dim(flat, start, stop)
+        flat = jnp.sort(flat)
+        neq = flat[1:] != flat[:-1]
+        if jnp.issubdtype(flat.dtype, jnp.floating):
+            neq &= ~(jnp.isnan(flat[1:]) & jnp.isnan(flat[:-1]))
+        mask = jnp.concatenate([jnp.ones(1, bool), neq])
+        return flat, mask, jnp.sum(mask, dtype=jnp.int32)
+    return jax.jit(run)
+
+
+def _unique_phase2(m, size, return_counts):
+    """Phase-2 program: gather ``size`` unique values (first-occurrence
+    indices) out of an ``m``-element sorted piece, with counts as index
+    differences; pad gathers clip to the last element and the host
+    trims.  Counts use the canonical int on device (int32 when x64 is
+    off — no warning); the host widens to int64 after the fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(s, msk):
+        idx = jnp.nonzero(msk, size=size, fill_value=m)[0]
+        uniq = jnp.take(s, idx, axis=0, mode="clip")
+        if not return_counts:
+            return (uniq,)   # skip the counts work and their transfer
+        ends = jnp.concatenate([idx[1:], jnp.asarray([m], idx.dtype)])
+        return uniq, (ends - idx).astype(
+            jax.dtypes.canonicalize_dtype(np.int64))
+    return jax.jit(run)
 
 
 # bincount accumulates per-chunk below this element count when the
@@ -368,50 +411,30 @@ def _unique_chunked(b, return_counts):
     its size to the next power of two so the compiled-program count
     stays logarithmic in the unique count, not linear in chunks."""
     import jax
-    import jax.numpy as jnp
     from bolt_tpu.tpu.array import _CHUNK_MAX_BYTES, _cached_jit
     data = b._data                          # chain materialises once
     mesh = b.mesh
     n = int(np.prod(data.shape))
     itemsize = np.dtype(data.dtype).itemsize
     chunk = max(1, _CHUNK_MAX_BYTES // itemsize)
-    floating = np.issubdtype(np.dtype(data.dtype), np.floating)
     vals_parts, cnt_parts = [], []
     for start in range(0, n, chunk):
         stop = min(start + chunk, n)
         m = stop - start
 
-        def p1_build(start=start, stop=stop):
-            def run(d):
-                flat = jnp.sort(jax.lax.slice_in_dim(
-                    d.reshape(-1), start, stop))
-                neq = flat[1:] != flat[:-1]
-                if floating:
-                    neq &= ~(jnp.isnan(flat[1:]) & jnp.isnan(flat[:-1]))
-                mask = jnp.concatenate([jnp.ones(1, bool), neq])
-                return flat, mask, jnp.sum(mask, dtype=jnp.int32)
-            return jax.jit(run)
-
         sorted_, mask, cnt = _cached_jit(
             ("unique-chunk-sort", data.shape, str(data.dtype), start,
-             stop, mesh), p1_build)(data)
+             stop, mesh),
+            lambda start=start, stop=stop: _unique_phase1(
+                (), 0, start, stop))(data)
         k = int(jax.device_get(cnt))
         kpad = 1 << max(0, (k - 1).bit_length())
 
-        def p2_build(m=m, kpad=kpad):
-            def run(s, msk):
-                idx = jnp.nonzero(msk, size=kpad, fill_value=m)[0]
-                uniq = jnp.take(s, idx, axis=0, mode="clip")
-                if not return_counts:
-                    return (uniq,)
-                ends = jnp.concatenate([idx[1:], jnp.asarray([m], idx.dtype)])
-                return uniq, (ends - idx).astype(
-                    jax.dtypes.canonicalize_dtype(np.int64))
-            return jax.jit(run)
-
         out = jax.device_get(_cached_jit(
             ("unique-chunk-gather", str(data.dtype), m, kpad,
-             return_counts, mesh), p2_build)(sorted_, mask))
+             return_counts, mesh),
+            lambda m=m, kpad=kpad: _unique_phase2(
+                m, kpad, return_counts))(sorted_, mask))
         vals_parts.append(np.asarray(out[0])[:k])
         if return_counts:
             cnt_parts.append(np.asarray(out[1])[:k].astype(np.int64))

@@ -115,17 +115,23 @@ def _hbm_limit():
         return int(env), True
     global _HBM_DEVICE_REPORT
     if _HBM_DEVICE_REPORT is None:
+        report = (None, False)                       # CPU: host RAM
         try:
             dev = jax.local_devices()[0]
-            stats = dev.memory_stats() or {}
-            if stats.get("bytes_limit"):
-                _HBM_DEVICE_REPORT = (int(stats["bytes_limit"]), True)
-            elif dev.platform == "tpu":
-                _HBM_DEVICE_REPORT = (_ASSUMED_TPU_HBM_BYTES, False)
-            else:
-                _HBM_DEVICE_REPORT = (None, False)   # CPU: host RAM
+            if dev.platform == "tpu":
+                # assumed default FIRST, so a raising memory_stats()
+                # (possible on remote attach) still leaves the guards
+                # armed rather than silently disabled
+                report = (_ASSUMED_TPU_HBM_BYTES, False)
         except Exception:
-            _HBM_DEVICE_REPORT = (None, False)
+            dev = None
+        try:
+            stats = (dev.memory_stats() or {}) if dev is not None else {}
+            if stats.get("bytes_limit"):
+                report = (int(stats["bytes_limit"]), True)
+        except Exception:
+            pass
+        _HBM_DEVICE_REPORT = report
     return _HBM_DEVICE_REPORT
 
 
@@ -134,11 +140,12 @@ def slab_plan(shape, axis, in_bytes):
     axis other than its target ``axis`` — slabs of at most
     ``_CHUNK_MAX_BYTES`` with a shared recipe so the chunked paths
     (argsort, topk) cannot drift.  ``None`` when no other axis can
-    carry the slabbing."""
-    cax = next((a for a in range(len(shape))
-                if a != axis and shape[a] > 1), None)
-    if cax is None:
+    carry the slabbing.  The LARGEST other axis carries it — a small
+    first axis could not cut slabs fine enough to honour the bound."""
+    cands = [a for a in range(len(shape)) if a != axis and shape[a] > 1]
+    if not cands:
         return None
+    cax = max(cands, key=lambda a: shape[a])
     nslabs = min(shape[cax], max(2, -(-in_bytes // _CHUNK_MAX_BYTES)))
     bounds = np.linspace(0, shape[cax], nslabs + 1).astype(int)
     pairs = [(int(s0), int(s1))

@@ -107,6 +107,26 @@ def test_np_quantile_numpy_only_method_falls_back(mesh):
     assert np.allclose(got, np.quantile(x, 0.5, method="inverted_cdf"))
 
 
+def test_slab_plan_picks_largest_carry_axis(monkeypatch):
+    # a small first axis cannot cut slabs fine enough to honour the
+    # byte bound; the plan must pick the LARGEST other axis (r3 review)
+    monkeypatch.setattr(array_mod, "_CHUNK_MAX_BYTES", 1 << 10)
+    cax, pairs = array_mod.slab_plan((2, 64, 8), axis=2, in_bytes=1 << 13)
+    assert cax == 1
+    assert len(pairs) == 8              # 8 KB / 1 KB target
+    assert pairs[0][0] == 0 and pairs[-1][1] == 64
+    assert array_mod.slab_plan((1, 16), axis=1, in_bytes=1 << 20) is None
+
+
+def test_topk_hbm_check_engages(mesh, monkeypatch):
+    # topk's unchunked paths carry the same up-front demand check as
+    # sort/argsort (r3 review finding: it had none)
+    monkeypatch.setattr(array_mod, "_HBM_LIMIT_OVERRIDE", 1 << 10)
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(MemoryError, match="topk"):
+        bolt.ops.topk(b, 2, axis=-1)
+
+
 def test_small_inputs_skip_chunked_paths(mesh):
     # below the threshold nothing slab-shaped compiles
     x = _x((6, 4))
