@@ -88,7 +88,7 @@ def instrument():
         print(bolt_tpu.profile.report(stats))
 
     ``stats`` maps op family — the executable-cache key prefix:
-    ``"chain"`` (materialising a deferred map chain), ``"map-wk"``,
+    ``"chain"`` (materialising a deferred map chain), ``"first"``,
     ``"reduce"``, ``"stat"`` (mean/sum/... family), ``"welford"``,
     ``"filter-fused"``, ``"swap"``, ``"getitem"``, ... — to
     ``{"calls", "builds", "dispatch_s"}``.  ``builds`` counts jit-cache

@@ -81,7 +81,33 @@ def test_swap_materialises(mesh):
     assert allclose(s.toarray(), np.transpose(x + 1, (1, 0, 2)))
 
 
-def test_with_keys_map_is_eager(mesh):
+def test_with_keys_map_defers_and_fuses(mesh):
+    # with_keys maps are lazy chain entries like plain maps (VERDICT r2
+    # weak-5): map(f, with_keys=True).sum() compiles ONE fused program
+    import bolt_tpu.profile as profile
     x = _x()
-    m = bolt.array(x, mesh).map(lambda kv: kv[1] + kv[0][0], with_keys=True)
-    assert not m.deferred
+    f = lambda kv: kv[1] + kv[0][0]                      # noqa: E731
+    m = bolt.array(x, mesh).map(f, with_keys=True)
+    assert m.deferred
+    keys = np.arange(x.shape[0]).reshape((-1,) + (1,) * (x.ndim - 1))
+    with profile.instrument() as stats:
+        out = m.sum()
+    assert stats.get("stat", {}).get("calls") == 1
+    assert "chain" not in stats and "map-wk" not in stats
+    assert allclose(np.asarray(out.toarray()), (x + keys).sum(axis=0))
+    # chains mixing plain and with_keys entries stay one program
+    m2 = (bolt.array(x, mesh).map(lambda v: v * 2)
+          .map(f, with_keys=True).map(lambda v: v - 1))
+    assert m2.deferred
+    assert allclose(m2.toarray(), x * 2 + keys - 1)
+    # first() on a (still) deferred with_keys chain runs a ONE-record
+    # program (toarray above materialised m2, so build a fresh chain)
+    m3 = (bolt.array(x, mesh).map(lambda v: v * 2)
+          .map(f, with_keys=True).map(lambda v: v - 1))
+    assert m3.deferred
+    with profile.instrument() as stats:
+        rec = m3.first()
+    assert "chain" not in stats          # the full chain never ran
+    assert stats.get("first", {}).get("calls") == 1
+    assert allclose(rec, x[0] * 2 - 1)
+    assert m3.deferred                   # first() left the chain lazy
