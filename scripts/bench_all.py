@@ -54,7 +54,7 @@ def sync(barray):
     return float(np.asarray(jax.device_get(data[(0,) * data.ndim])))
 
 
-def timed_tpu(launch, iters=10, keep_all=True):
+def timed_tpu(launch, iters=40, keep_all=True):
     """Steady-state device time per iteration.
 
     ``launch()`` must asynchronously dispatch one full iteration and return
@@ -64,7 +64,16 @@ def timed_tpu(launch, iters=10, keep_all=True):
     result and subtracted.  ``keep_all=False`` drops intermediate result
     handles as the loop runs (PJRT frees each buffer once its execution
     retires) — required for multi-GB outputs, where holding every
-    iteration's result would overflow HBM."""
+    iteration's result would overflow HBM (the runtime keeps ~2
+    executions in flight, so queue depth never stacks buffers).
+
+    ROUND-3 CORRECTION (BASELINE.md "measurement correction"): the
+    subtracted round-trip is NOISY on this attach (28–110 ms, drifting
+    between its measurement and its use), so the residual error is
+    ~drift/iters per iteration.  ``iters`` therefore defaults HIGH (40):
+    at 40 launches even an 80 ms drift biases a per-iter figure by only
+    2 ms.  Callers timing sub-50 ms ops must not lower it; slow ops
+    (≥0.2 s/iter) may, since the bias is relatively tiny there."""
     tail = launch()
     sync(tail)  # compile + warm
     rts = []
@@ -105,12 +114,25 @@ SVALS = lambda blk: jnp.linalg.svd(blk, compute_uv=False)[None, :]
 # ----------------------------------------------------------------------
 
 def lcg_np(shape, salt=0):
+    # blockwise + in-place: the naive expression materialises ~6 full-
+    # size temporaries, which on a slow host measured 158 s for 4.3 GB;
+    # one preallocated output and 64 MB scratch blocks cut that ~4x
     n = int(np.prod(shape))
-    i = np.arange(n, dtype=np.uint32) + np.uint32(salt)
-    v = i * np.uint32(2654435761) + np.uint32(12345)
-    v ^= v >> np.uint32(13)
-    return ((v >> np.uint32(8)).astype(np.float32)
-            / np.float32(1 << 24) - np.float32(0.5)).reshape(shape)
+    out = np.empty(n, np.float32)
+    step = 1 << 24
+    for s in range(0, n, step):
+        e = min(s + step, n)
+        v = np.arange(s, e, dtype=np.uint32)
+        v += np.uint32(salt)
+        v *= np.uint32(2654435761)
+        v += np.uint32(12345)
+        v ^= v >> np.uint32(13)
+        v >>= np.uint32(8)
+        blk = v.astype(np.float32)
+        blk /= np.float32(1 << 24)
+        blk -= np.float32(0.5)
+        out[s:e] = blk
+    return out.reshape(shape)
 
 
 def lcg_tpu(shape, axis=(0,), salt=0):
@@ -139,6 +161,11 @@ def fetch(barray, index):
 
 
 def main():
+    def _progress(*row):
+        print("done: %s  local=%.3fs tpu=%.4fs %s" % row,
+              file=sys.stderr, flush=True)
+        return row
+
     rows = []
     rs = np.random.RandomState(0)
 
@@ -150,7 +177,7 @@ def main():
     lo, lt = timed(lambda: float((xl + 1).sum(dtype=np.float32)))
     to_arr, tt = timed_tpu(lambda: bt.map(ADD1).sum(axis=axes))
     to = float(to_arr.toarray())
-    rows.append(("1 map->sum 0.66GB", lt, tt, "bit-exact" if lo == to else "MISMATCH"))
+    rows.append(_progress("1 map->sum 0.66GB", lt, tt, "bit-exact" if lo == to else "MISMATCH"))
 
     # ---- config 2: ufuncs + axis reductions over the split axis ------
     # 2.1 GB (round 2): the round-1 268 MB shape measured 3.6 ms — at or
@@ -176,13 +203,14 @@ def main():
     # reduced outputs are small (value-shaped): full-fetch parity
     ok = all(allclose(a, np.asarray(b.toarray()), rtol=1e-4, atol=1e-5)
              for a, b in zip(lo, tpu2_outs))
-    rows.append(("2 ufunc+reductions 2.1GB", lt, tt, "allclose" if ok else "MISMATCH"))
+    rows.append(_progress("2 ufunc+reductions 2.1GB", lt, tt, "allclose" if ok else "MISMATCH"))
     del x
 
     # ---- config 3: swap() key<->value exchange on a 4D array ---------
-    # 2.1 GB (round 2, was 512 MB / 0.7 ms — floor-bound); intermediate
-    # swap outputs are dropped as the loop runs (5 retained 2.1 GB
-    # results plus the input would overflow HBM)
+    # 4.3 GB (round 2, was 512 MB / 0.7 ms — floor-bound); intermediate
+    # swap outputs are dropped as the loop runs (keep_all=False: 24
+    # retained 4.3 GB results would overflow HBM many times over — the
+    # runtime's ~2 in-flight executions bound the true watermark)
     del bt
     # 4.3 GB: at 2.1 GB the swap measured 6.3 ms — genuinely ~670 GB/s
     # read+write but still within 3x of the dispatch floor; doubling the
@@ -194,16 +222,16 @@ def main():
     x = lcg_np(shape3, salt=3)
     bt = lcg_tpu(shape3, axis=(0, 1), salt=3).cache()
     lo_arr, lt = timed(
-        lambda: np.ascontiguousarray(np.transpose(x, (1, 2, 0, 3))), iters=2)
+        lambda: np.ascontiguousarray(np.transpose(x, (1, 2, 0, 3))), iters=1)
 
-    to, tt = timed_tpu(lambda: bt.swap((0,), (0,)), iters=6, keep_all=False)
+    to, tt = timed_tpu(lambda: bt.swap((0,), (0,)), iters=24, keep_all=False)
     # 4.3 GB output: parity on sampled slices (identical LCG data on both
     # sides), not a minutes-long full fetch through the tunnel
     ok = (to.shape == lo_arr.shape
           and allclose(lo_arr[5, 9], fetch(to, np.s_[5, 9]))
           and allclose(lo_arr[127, 63], fetch(to, np.s_[127, 63]))
           and allclose(lo_arr[:, 0, 17], fetch(to, np.s_[:, 0, 17])))
-    rows.append(("3 swap all-to-all 4.3GB", lt, tt, "exact*" if ok else "MISMATCH"))
+    rows.append(_progress("3 swap all-to-all 4.3GB", lt, tt, "exact*" if ok else "MISMATCH"))
     del x, lo_arr
 
     # ---- config 4: filter() / boolean mask on the keyed axis ---------
@@ -217,13 +245,16 @@ def main():
     lo_arr, lt = timed(lambda: x[x.mean(axis=(1, 2)) > 0], iters=2)
 
     # filter dispatches async (lazy-count pending result); the closing
-    # sync resolves the last iteration's count + probe
-    to, tt = timed_tpu(lambda: bt.filter(MEANPOS), iters=5)
+    # sync resolves the last iteration's count + probe.  keep_all=False:
+    # at 24 iterations the pending results' padded buffers (0.94 GB
+    # each) must retire as the loop runs, not accumulate
+    to, tt = timed_tpu(lambda: bt.filter(MEANPOS), iters=24,
+                       keep_all=False)
     # ~0.5 GB of survivors: parity on count + sampled survivor rows
     ok = (to.shape == lo_arr.shape
           and allclose(lo_arr[:2], fetch(to, np.s_[:2]))
           and allclose(lo_arr[-1], fetch(to, np.s_[-1])))
-    rows.append(("4 filter mask 0.94GB", lt, tt, "exact*" if ok else "MISMATCH"))
+    rows.append(_progress("4 filter mask 0.94GB", lt, tt, "exact*" if ok else "MISMATCH"))
     del x, lo_arr
 
     # ---- config 5: per-chunk SVD (tall-skinny PCA) -------------------
@@ -245,7 +276,7 @@ def main():
         iters=5)
     # output is small ((8, 4096, 16) = 2 MB): full-fetch parity
     ok = allclose(lo_arr, to.toarray().reshape(lo_arr.shape), rtol=1e-2, atol=1e-2)
-    rows.append(("5 per-chunk SVD 2.1GB", lt, tt, "allclose" if ok else "MISMATCH"))
+    rows.append(_progress("5 per-chunk SVD 2.1GB", lt, tt, "allclose" if ok else "MISMATCH"))
 
     # ---- config 5b: same workload, TPU-first algorithm ---------------
     # singular values via the Gram matrix (MXU matmul + small eigvalsh)
@@ -256,7 +287,7 @@ def main():
         lambda: bt.chunk(size=(csize,), axis=(0,)).map(GRAM).unchunk(),
         iters=5)
     ok = allclose(lo_arr, to.toarray().reshape(lo_arr.shape), rtol=1e-2, atol=1e-2)
-    rows.append(("5b gram-SVD (MXU) 2.1GB", lt, tt, "allclose" if ok else "MISMATCH"))
+    rows.append(_progress("5b gram-SVD (MXU) 2.1GB", lt, tt, "allclose" if ok else "MISMATCH"))
 
     print("%-26s %10s %10s %9s  %s" % ("config", "local s", "tpu s", "speedup", "parity"))
     for name, lt, tt, parity in rows:

@@ -44,16 +44,57 @@ OUT = os.path.join(ROOT, "PERF.json")
 BASE = os.path.join(ROOT, "PERF_BASELINE.json")
 
 
-# ONE timing harness: bench_all's pipelined steady-state methodology
-# (closing-probe round-trip measured and subtracted; keep_all=False frees
-# the warm result and in-flight handles for multi-GB outputs)
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from bench_all import timed_tpu  # noqa: E402
+# TIMING (reworked round 3, VERDICT r2 #7): this environment's attach
+# tunnel has a LARGE, NOISY fetch/dispatch latency (measured 28-110 ms
+# for one host round-trip, varying minute to minute).  The round-2
+# methodology — few iterations plus a measured-and-subtracted probe
+# round-trip — left a residual of tens of ms whenever the round-trip
+# drifted between its measurement and its use, which silently turned
+# sub-5 GB families into LATENCY measurements: map_sum read 99.9 GB/s
+# and filter 31 GB/s while the same programs measure 366 / ~110 GB/s
+# with the fetch amortized (a bare 2-pass COPY "measured" 30 GB/s under
+# the old scheme — the smoking gun).  Two fetch-proof forms replace it:
+#
+# * ``steady_amortized`` — queue many independent launches, ONE closing
+#   fetch; bias <= round-trip/iters (~2.3 ms at the default 48; the
+#   pca family accepts ~14 ms at iters=8 against its 0.23 s/iter
+#   signal).  For families whose outputs are small (reductions) so
+#   queued results can't fill HBM.
+# * ``steady_chain`` — each launch consumes the previous result, so at
+#   most two buffers are ever alive regardless of queue depth; same
+#   single amortized fetch.  For families with input-sized outputs
+#   (swap, matmul, halo, filter-via-padded-buffer).
+
+_PROBE = jax.jit(lambda t: t.ravel()[0])
 
 
-def steady(launch, iters=6, keep_all=True):
-    _, sec = timed_tpu(launch, iters=iters, keep_all=keep_all)
-    return sec
+def _tiny(r):
+    """Reduce a family result to a one-scalar fetch (families return a
+    bolt array, a jax array, or a tuple whose head is one)."""
+    if isinstance(r, tuple):
+        r = r[0]
+    return _PROBE(r.tojax() if hasattr(r, "tojax") else r)
+
+
+def steady_amortized(launch, iters=48):
+    jax.device_get(_tiny(launch()))          # compile + drain
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = launch()
+    jax.device_get(_tiny(r))
+    return (time.perf_counter() - t0) / iters
+
+
+def steady_chain(x0, step, iters=24, warm=4):
+    x = x0
+    for _ in range(warm):                    # compile the cycle's programs
+        x = step(x)
+    jax.device_get(_tiny(x))                 # drain
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = step(x)
+    jax.device_get(_tiny(x))
+    return (time.perf_counter() - t0) / iters
 
 
 # Every family generates its data ON DEVICE (bolt.randn/ones): shipping a
@@ -69,7 +110,7 @@ FILTER_PRED = lambda v: v.mean() > 0
 def fam_map_sum():
     shape = (8192, 256, 256)                      # 2.1 GB f32
     b = bolt.ones(shape, mode="tpu", dtype=np.float32).cache()
-    return int(np.prod(shape)) * 4, steady(
+    return int(np.prod(shape)) * 4, steady_amortized(
         lambda: b.map(MAPSUM_FN).sum(axis=(0, 1, 2)))
 
 
@@ -86,55 +127,57 @@ def fam_stats_welford():
     b.stats()
     prog = next(v for k, v in _JIT_CACHE.items() if k[0] == "welford")
     data = b._data
-    probe = jax.jit(lambda t: t[0].ravel()[0])
-    warm = prog(data)
-    jax.device_get(probe(warm))
-    rts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        jax.device_get(probe(warm))
-        rts.append(time.perf_counter() - t0)
-    rt = min(rts)
-    iters = 6
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = prog(data)
-    jax.device_get(probe(r))
-    return nbytes, (time.perf_counter() - t0 - rt) / iters
+    return nbytes, steady_amortized(lambda: prog(data))
 
 
 def fam_swap():
     shape = (1024, 128, 64, 64)                   # 2.1 GB
     b = bolt.randn(shape, mode="tpu", axis=(0, 1), seed=3,
                    dtype=np.float32).cache()
-    return int(np.prod(shape)) * 4, steady(
-        lambda: b.swap((0,), (0,)), iters=5, keep_all=False)
+    # NOT a chain: chained swaps rotate through arrangements whose
+    # transposes cost wildly different amounts (some move the minor
+    # dim), which would measure a layout mix instead of THE exchange.
+    # Amortized queueing is safe — the runtime keeps ~2 executions in
+    # flight, so 2.1 GB outputs never stack (measured: no OOM at 48).
+    return int(np.prod(shape)) * 4, steady_amortized(
+        lambda: b.swap((0,), (0,)), iters=48)
 
 
 def fam_filter_fused():
+    from bolt_tpu.tpu.array import BoltArrayTPU
     shape = (14336, 256, 64)                      # 0.94 GB
     b = bolt.randn(shape, mode="tpu", seed=4, dtype=np.float32).cache()
-    return int(np.prod(shape)) * 4, steady(
-        lambda: b.filter(FILTER_PRED), iters=5)
+
+    def step(arr):
+        # the padded compaction buffer has the input's shape, so the
+        # chain feeds each filter the previous one's buffer (garbage
+        # rows are data like any other) — one cached program throughout
+        out = arr.filter(FILTER_PRED)
+        return BoltArrayTPU(out._pending[0], 1, arr.mesh)
+
+    return int(np.prod(shape)) * 4, steady_chain(b, step, iters=24)
 
 
 def fam_matmul():
     # the MXU path (highest precision, numpy-parity default); the weight
     # is device-resident — a host ndarray operand would re-upload per call
     n = 8192                                      # 0.8 GB of operands
-    w = bolt.randn((n, n), mode="tpu", seed=8, dtype=np.float32).tojax()
+    # x @ w keeps the shape: chain the product through itself; w is
+    # scaled so the chain's magnitude stays ~O(1) per link (a randn
+    # product grows ~sqrt(n)x per matmul — 16 links would reach f32 inf)
+    w = bolt.randn((n, n), mode="tpu", seed=8, dtype=np.float32).tojax() \
+        * np.float32(1.0 / np.sqrt(n))
     b = bolt.randn((n, n), mode="tpu", seed=7, dtype=np.float32).cache()
-    return 2 * n * n * 4, steady(
-        lambda: b @ w, iters=5, keep_all=False)
+    return 2 * n * n * 4, steady_chain(b, lambda x: x @ w, iters=16)
 
 
 def fam_halo_gaussian():
     from bolt_tpu.ops import gaussian
     shape = (64, 2048, 4096)                      # 2.1 GB
     b = bolt.randn(shape, mode="tpu", seed=6, dtype=np.float32).cache()
-    return int(np.prod(shape)) * 4, steady(
-        lambda: gaussian(b, sigma=2.0, axis=(0, 1), size="64"),
-        iters=4, keep_all=False)
+    return int(np.prod(shape)) * 4, steady_chain(
+        b, lambda x: gaussian(x, sigma=2.0, axis=(0, 1), size="64"),
+        iters=12)
 
 
 def fam_segment_reduce():
@@ -146,9 +189,9 @@ def fam_segment_reduce():
     b = bolt.randn(shape, mode="tpu", seed=9, dtype=np.float32).cache()
     labels = np.arange(shape[0]) % 256
 
-    return int(np.prod(shape)) * 4, steady(
+    return int(np.prod(shape)) * 4, steady_amortized(
         lambda: segment_reduce(b, labels, num_segments=256, op="sum"),
-        iters=5)
+        iters=32)
 
 
 def fam_pca():
@@ -157,8 +200,10 @@ def fam_pca():
 
     def run_pca():
         scores, comps, svals = pca(b, k=4, center=True)
-        return scores
-    return 33554432 * 16 * 4, steady(run_pca, iters=3, keep_all=False)
+        return svals            # scores stay sharded in HBM; probe the
+                                # small vector so queued iterations don't
+                                # stack score buffers
+    return 33554432 * 16 * 4, steady_amortized(run_pca, iters=8)
 
 
 FAMILIES = [
