@@ -100,12 +100,35 @@ class BoltArray(metaclass=ABCMeta):
     # ------------------------------------------------------------------
 
     @abstractmethod
-    def toarray(self):
-        """Materialise as a host ``numpy.ndarray`` in key order."""
+    def toarray(self, out=None):
+        """Materialise as a host ``numpy.ndarray`` in key order; with
+        ``out=`` (a writable shape/dtype-matched array, e.g. a memmap)
+        the gather writes into the caller's buffer instead of
+        allocating."""
+
+    @abstractmethod
+    def iter_shards(self):
+        """Yield ``(index, block)`` host copies per locally-addressable
+        shard — the assembly-free collect (one whole-array block on the
+        local backend)."""
 
     @abstractmethod
     def tolocal(self):
         """Convert to the ``mode='local'`` backend."""
+
+    @staticmethod
+    def _check_out(out, shape, dtype):
+        """Shared ``out=`` validation for :meth:`toarray` — one
+        implementation so the backends' messages cannot drift."""
+        import numpy as np
+        if tuple(out.shape) != tuple(shape):
+            raise ValueError("out has shape %s, expected %s"
+                             % (tuple(out.shape), tuple(shape)))
+        if np.dtype(out.dtype) != np.dtype(dtype):
+            raise ValueError(
+                "out has dtype %s, expected %s (toarray does not cast)"
+                % (out.dtype, np.dtype(dtype)))
+        return out
 
     def totpu(self, context=None, axis=(0,)):
         """Convert to the ``mode='tpu'`` backend, distributing ``axis`` as

@@ -306,8 +306,21 @@ class BoltArrayLocal(np.ndarray, BoltArray):
             arry = arry.toarray()
         return BoltArrayLocal(np.concatenate((np.asarray(self), np.asarray(arry)), axis))
 
-    def toarray(self):
+    def toarray(self, out=None):
+        if out is not None:
+            BoltArray._check_out(out, self.shape, self.dtype)
+            out[...] = np.asarray(self)
+            return out
         return np.asarray(self)
+
+    def iter_shards(self):
+        """Single-shard analog of the distributed backend's
+        :meth:`~bolt_tpu.tpu.array.BoltArrayTPU.iter_shards`: one
+        ``(index, block)`` covering the whole array, so shard-walking
+        code is mode-agnostic.  The block is a COPY, like the device
+        backend's host fetches — mutating it never aliases the array."""
+        yield (tuple(slice(0, d) for d in self.shape),
+               np.array(np.asarray(self)))
 
     def tolocal(self):
         return self

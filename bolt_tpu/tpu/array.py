@@ -2108,12 +2108,21 @@ class BoltArrayTPU(BoltArray):
     # conversions / persistence
     # ------------------------------------------------------------------
 
-    def toarray(self):
+    def toarray(self, out=None):
         """Gather to a host ``numpy.ndarray`` in key order (reference:
         ``BoltArraySpark.toarray`` = sortByKey → collect → reshape; here a
         single ``device_get`` — ordering is intrinsic, SURVEY §3.5).  On a
         multi-host mesh, shards the local process cannot address are
         all-gathered over DCN first.
+
+        HOST-RAM MODEL: every process receives the FULL logical array —
+        that is ``toarray``'s contract (device memory stays bounded; see
+        ``_gather_multihost``), so the host must hold ``size × itemsize``
+        bytes per process.  For arrays bigger than host RAM pass ``out=``
+        (any writable shape/dtype-matching array, e.g. an
+        ``np.lib.format.open_memmap`` / ``np.memmap``) and the gather
+        writes into it shard by shard; or skip assembly entirely with
+        :meth:`iter_shards`.
 
         A small pending ``filter`` result is fetched in ONE batched
         transfer (padded buffer + survivor count together) and sliced on
@@ -2128,17 +2137,47 @@ class BoltArrayTPU(BoltArray):
                     and padded.size * padded.dtype.itemsize
                     <= _PENDING_FETCH_MAX_BYTES):
                 p, c = jax.device_get((padded, cnt))
-                out = np.asarray(p)[:int(c)].copy()
+                c = int(c)
                 # the count is on host now: resolve device-side without a
                 # second sync, releasing the padded buffer
-                self._resolve_pending(count=int(c))
-                return out
+                self._resolve_pending(count=c)
+                if out is not None:
+                    # out= keeps the single batched round-trip: validate
+                    # against the now-known filtered shape, copy the
+                    # survivor slice in
+                    BoltArray._check_out(
+                        out, (c,) + tuple(padded.shape[1:]), padded.dtype)
+                    out[...] = np.asarray(p)[:c]
+                    return out
+                return np.asarray(p)[:c].copy()
         data = self._data
+        if out is not None:
+            BoltArray._check_out(out, data.shape, data.dtype)
         if not data.is_fully_addressable:
-            return self._gather_multihost(data)
+            return self._gather_multihost(data, out=out)
+        if out is not None:
+            # shard-wise writes: the only full-size host buffer is the
+            # caller's target (which may be a memmap)
+            for sh in data.addressable_shards:
+                out[sh.index] = np.asarray(jax.device_get(sh.data))
+            return out
         return np.asarray(jax.device_get(data))
 
-    def _gather_multihost(self, data):
+    def iter_shards(self):
+        """Yield ``(index, block)`` for every shard THIS process can
+        address — ``index`` the tuple of slices locating the block in the
+        logical array, ``block`` its host ndarray.  The zero-assembly
+        collect: per-shard host RAM instead of ``toarray``'s full-array
+        buffer, and on a multi-host mesh no DCN traffic at all (each
+        process walks its own shards; a replicated array yields every
+        shard from every process).  Blocks are WRITABLE host copies on
+        both backends (a bare device_get view is read-only), so shard-
+        walking code can scribble without mode-dependent aliasing."""
+        data = self._data
+        for sh in data.addressable_shards:
+            yield sh.index, np.array(jax.device_get(sh.data))
+
+    def _gather_multihost(self, data, out=None):
         """Shard-wise cross-host gather with bounded device memory at ANY
         array size (VERDICT r1 missing-2: ``process_allgather(tiled)``
         replicates the FULL logical array on every device, OOMing every
@@ -2160,7 +2199,11 @@ class BoltArrayTPU(BoltArray):
         from jax.experimental import multihost_utils
         shape = tuple(data.shape)
         dtype = np.dtype(data.dtype)
-        out = np.empty(shape, dtype)
+        if out is None:
+            # the full-array host buffer toarray's contract requires;
+            # callers with less host RAM pass out= (e.g. a memmap) or
+            # use iter_shards
+            out = np.empty(shape, dtype)
         pid = jax.process_index()
 
         def norm(idx):

@@ -74,6 +74,20 @@ def worker(pid):
     full = m.toarray()  # cross-host gather path
     assert np.allclose(full, x * 2 + 1)
 
+    # out= target: the cross-host gather writes into a caller buffer
+    # (memmap-style) instead of allocating the full array itself
+    target = np.zeros(m.shape, m.dtype)
+    got = m.toarray(out=target)
+    assert got is target and np.allclose(target, x * 2 + 1)
+
+    # iter_shards: every process walks ONLY its own shards, no DCN at
+    # all; the union across processes is the whole array
+    count = 0
+    for index, block in b.iter_shards():
+        assert np.allclose(block, x[index])
+        count += block.size
+    assert count == x.size // max(1, NPROC) or NPROC == 1
+
     # first(): the one-record fetch must work when the first shard lives
     # on another process (jax replicates the int-indexed record)
     assert np.allclose(b.first(), x[0])

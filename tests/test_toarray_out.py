@@ -1,0 +1,87 @@
+"""toarray(out=) and iter_shards (VERDICT r2 weak-6): bounding the HOST
+RAM side of the collect — out= writes shard-wise into a caller buffer
+(e.g. a memmap), iter_shards skips assembly entirely."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+
+
+def _x():
+    return np.random.RandomState(50).randn(16, 6, 4)
+
+
+def test_toarray_out_both_backends(mesh):
+    x = _x()
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        out = np.empty_like(x)
+        got = b.toarray(out=out)
+        assert got is out
+        assert np.array_equal(out, x), b.mode
+
+
+def test_toarray_out_memmap(mesh, tmp_path):
+    x = _x()
+    b = bolt.array(x, mesh)
+    mm = np.lib.format.open_memmap(
+        str(tmp_path / "out.npy"), mode="w+", dtype=x.dtype, shape=x.shape)
+    got = b.toarray(out=mm)
+    assert got is mm
+    mm.flush()
+    back = np.load(str(tmp_path / "out.npy"))
+    assert np.array_equal(back, x)
+
+
+def test_toarray_out_validation(mesh):
+    x = _x()
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        with pytest.raises(ValueError, match="shape"):
+            b.toarray(out=np.empty((3, 3)))
+        with pytest.raises(ValueError, match="cast"):
+            b.toarray(out=np.empty(x.shape, np.float32))
+
+
+def test_toarray_out_materialises_chain_and_pending(mesh):
+    x = _x()
+    m = bolt.array(x, mesh).map(lambda v: v * 2)
+    out = np.empty_like(x)
+    m.toarray(out=out)
+    assert np.allclose(out, x * 2)
+    f = bolt.array(x, mesh).filter(lambda v: v.mean() > 0)
+    keep = x[x.mean(axis=(1, 2)) > 0]
+    out2 = np.empty_like(keep)
+    f.toarray(out=out2)
+    assert np.allclose(out2, keep)
+
+
+def test_iter_shards_blocks_never_alias(mesh):
+    # blocks are COPIES on both backends: mutating one must not corrupt
+    # the source array (r3 review finding: the local view aliased)
+    x = _x()
+    for b in (bolt.array(x.copy()), bolt.array(x, mesh)):
+        for _, block in b.iter_shards():
+            block *= 0.0
+        assert np.allclose(np.asarray(b.toarray()), x), b.mode
+
+
+def test_iter_shards_covers_array(mesh):
+    x = _x()
+    for b in (bolt.array(x), bolt.array(x, mesh)):
+        seen = np.full(x.shape, np.nan)
+        total = 0
+        for index, block in b.iter_shards():
+            seen[index] = block
+            total += block.size
+        assert np.allclose(seen, x), b.mode     # union covers everything
+        assert total == x.size                  # single-process: no overlap
+    # the TPU shards are genuinely partial (8-way mesh splits axis 0)
+    blocks = [blk for _, blk in bolt.array(x, mesh).iter_shards()]
+    assert len(blocks) == 8
+    assert all(blk.shape == (2, 6, 4) for blk in blocks)
+    # a deferred chain materialises through the iterator
+    m = bolt.array(x, mesh).map(lambda v: v + 1)
+    seen = np.empty_like(x)
+    for index, block in m.iter_shards():
+        seen[index] = block
+    assert np.allclose(seen, x + 1)
