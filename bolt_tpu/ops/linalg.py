@@ -429,7 +429,8 @@ def tsqr(x):
     return q, jnp.matmul(r2, r1, precision="highest")
 
 
-def pca(b, k=None, center=False, axis=None, return_mean=False):
+def pca(b, k=None, center=False, axis=None, return_mean=False,
+        fetch=True):
     """Distributed PCA of a bolt array: sample axes x feature axes, all
     in ONE compiled SPMD program.
 
@@ -460,6 +461,12 @@ def pca(b, k=None, center=False, axis=None, return_mean=False):
     element is the per-feature mean ``(d,)`` that was subtracted (zeros
     when ``center=False``) — needed to project NEW data consistently:
     ``scores_new = (x_new - mean) @ components``.
+
+    ``fetch=False`` (TPU mode) returns components/singular values/mean
+    as DEVICE-resident ``jax.Array``s instead of host ndarrays: the call
+    then syncs nothing — back-to-back pca calls (or downstream jnp use
+    of the components) pipeline without paying a host round-trip each,
+    which on a remote attach is the dominant per-call cost.
     """
     mode, b, x_full, split, shape, n, d = _samples_features(
         b, axis, "pca", hint="; for plain matrices use tallskinny_pca")
@@ -510,9 +517,18 @@ def pca(b, k=None, center=False, axis=None, return_mean=False):
     fn = _cached_jit(("ops-pca", funcs, base.shape, str(base.dtype), split,
                       mesh, k, center), build)
     scores, vec, sv, mu = fn(base)
-    out = (type(b)(scores, split, mesh), np.asarray(jax.device_get(vec)),
-           np.asarray(jax.device_get(sv)))
-    return out + (np.asarray(jax.device_get(mu)),) if return_mean else out
+    wrapped = type(b)(scores, split, mesh)
+    if not fetch:
+        # async path: nothing syncs — small results stay on device
+        return (wrapped, vec, sv, mu) if return_mean else (wrapped, vec, sv)
+    # ONE batched host fetch for the small results: separate device_gets
+    # cost a full host round-trip EACH (2x the per-call latency of the
+    # whole API on a remote attach; measured in the pca perf family)
+    if return_mean:
+        vec, sv, mu = jax.device_get((vec, sv, mu))
+        return wrapped, np.asarray(vec), np.asarray(sv), np.asarray(mu)
+    vec, sv = jax.device_get((vec, sv))
+    return wrapped, np.asarray(vec), np.asarray(sv)
 
 
 def tallskinny_pca(x, k=None):
@@ -610,8 +626,10 @@ def cov(b, axis=None, center=True, ddof=1, return_mean=False):
     fn = _cached_jit(("ops-cov", funcs, base.shape, str(base.dtype), split,
                       mesh, center, ddof), build)
     c, mu = fn(base)
-    c = np.asarray(jax.device_get(c))
-    return (c, np.asarray(jax.device_get(mu))) if return_mean else c
+    if return_mean:
+        c, mu = jax.device_get((c, mu))    # one batched round-trip
+        return np.asarray(c), np.asarray(mu)
+    return np.asarray(jax.device_get(c))
 
 
 def corrcoef(b, axis=None):

@@ -16,6 +16,7 @@ contract runs on NumPy (the oracle).
 from functools import lru_cache
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from bolt_tpu.utils import chunk_axes, iterexpand, tupleize
@@ -121,13 +122,86 @@ def _sepfilter_fn(taps_key, axes, mode):
 
 def _separable_filter(b, taps_list, axes, size, mode, shard=None):
     """Shared core of :func:`smooth`/:func:`convolve`/:func:`gaussian`:
-    one halo-padded blockwise program applying a 1-d tap filter per axis."""
+    one program applying a 1-d tap filter per axis.
+
+    On the TPU backend (no ``shard=``) the filter runs as ONE
+    whole-array program whose per-axis correlations are Pallas window
+    kernels where the plan allows — each block reads HBM once and
+    windows in VMEM, where the XLA shifted-slice form re-reads the
+    operand once per tap (measured 112 → ~40 ms for a 9-tap 2-axis
+    gaussian on 2.1 GB; round-3).  Anything the kernel can't serve
+    (unplannable geometry, non-float dtype, a failed compile on this
+    toolchain) falls back to the halo-chunked machinery, which also
+    serves ``shard=`` (sequence-parallel) and the local oracle."""
     mode = _canon_mode(mode)
     depth = tuple(len(t) // 2 for t in taps_list)
     taps_key = tuple(tuple(float(t) for t in taps) for taps in taps_list)
+    if b.mode == "tpu" and shard is None:
+        out = _whole_array_sepfilter(b, taps_key, tuple(axes), mode)
+        if out is not None:
+            return out
     sepfilter = _sepfilter_fn(taps_key, tuple(axes), mode)
     return map_overlap(b, sepfilter, depth, axis=axes, size=size,
                        shard=shard)
+
+
+def _whole_array_sepfilter(b, taps_key, axes, mode):
+    """ONE compiled program filtering every requested axis of the full
+    (sharded) array — Pallas window kernel per axis, shifted-slice for
+    any axis the plan can't serve.  Returns None (caller takes the
+    chunked path) when no axis can use the kernel or the compile fails
+    on this toolchain (the kernel's Mosaic surface varies by version;
+    a flaky remote-compile must degrade, not crash)."""
+    import numpy as _np
+    from bolt_tpu.ops import kernels
+    from bolt_tpu.tpu.array import (_cached_jit, _chain_apply, _check_live,
+                                    _constrain)
+    split = b.split
+    active = [(split + a, taps) for a, taps in zip(axes, taps_key)
+              if len(taps) > 1 or taps[0] != 1.0]
+    if not active:
+        # identity filter: a NEW wrapper, never the input itself (the
+        # in-place surface — sort, wrapper rebinds — must not alias)
+        return b._clone()
+    itemsize = _np.dtype(b.dtype).itemsize
+    if not _np.issubdtype(_np.dtype(b.dtype), _np.floating):
+        return None
+    if not any(kernels.sepfilter_capable(b.shape, itemsize, g, len(t))
+               for g, t in active):
+        return None
+    mesh = b.mesh
+    base, funcs = b._chain_parts()
+    key = ("sepfilter", taps_key, axes, mode, funcs, base.shape,
+           str(base.dtype), split, mesh)
+    if key in _SEPFILTER_FAILED:
+        return None                        # this toolchain said no once
+
+    def build():
+        def run(d):
+            x = _chain_apply(funcs, split, d)
+            for g, taps in active:
+                y = kernels.sepfilter1d(x, taps, g, mode=mode)
+                x = y if y is not None else _filter1d(x, g, taps, mode, jnp)
+            return _constrain(x, mesh, split)
+        return jax.jit(run)
+
+    try:
+        fn = _cached_jit(key, build)
+        out = fn(_check_live(base))
+    except Exception:
+        # a Mosaic/remote-compile failure: remember it (retrying would
+        # pay the failed compile EVERY call), purge the cached program,
+        # and let the chunked path serve this geometry from now on
+        from bolt_tpu.tpu.array import _JIT_CACHE
+        _JIT_CACHE.pop(key, None)
+        _SEPFILTER_FAILED.add(key)
+        return None
+    return b._wrap(out, split)
+
+
+# geometries whose kernel program failed to compile on this toolchain —
+# they take the chunked path without re-paying the failed compile
+_SEPFILTER_FAILED = set()
 
 
 def _filter_axes(b, axis):

@@ -2156,10 +2156,13 @@ class BoltArrayTPU(BoltArray):
         if not data.is_fully_addressable:
             return self._gather_multihost(data, out=out)
         if out is not None:
-            # shard-wise writes: the only full-size host buffer is the
-            # caller's target (which may be a memmap)
-            for sh in data.addressable_shards:
-                out[sh.index] = np.asarray(jax.device_get(sh.data))
+            # shard-wise writes into the caller's target (which may be a
+            # memmap) — fetched in ONE batched device_get (per-shard
+            # gets would pay a host round-trip EACH)
+            shards = data.addressable_shards
+            blocks = jax.device_get([sh.data for sh in shards])
+            for sh, blk in zip(shards, blocks):
+                out[sh.index] = np.asarray(blk)
             return out
         return np.asarray(jax.device_get(data))
 

@@ -199,7 +199,11 @@ def fam_pca():
     b = bolt.randn((33554432, 16), mode="tpu", seed=5).cache()  # 2.1 GB
 
     def run_pca():
-        scores, comps, svals = pca(b, k=4, center=True)
+        # fetch=False: the async path — the default's batched host fetch
+        # of comps/svals is ONE tunnel round-trip per call, which on this
+        # attach would dominate the measurement (~0.1 s vs the program's
+        # tens of ms); the family gates the compiled program
+        scores, comps, svals = pca(b, k=4, center=True, fetch=False)
         return svals            # scores stay sharded in HBM; probe the
                                 # small vector so queued iterations don't
                                 # stack score buffers
