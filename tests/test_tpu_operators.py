@@ -309,3 +309,20 @@ def test_foreign_device_operand_falls_back(mesh):
     b = bolt.array(x, half)
     w = jax.device_put(np.ones(x.shape), jax.devices()[6])
     assert allclose((b + w).toarray(), x + 1)
+
+
+def test_dot_precision_option(mesh):
+    # dot(precision=) opts into faster MXU passes; "highest" (default)
+    # stays ulp-parity with the oracle, "default" is allclose at ~1e-2
+    x = np.random.RandomState(70).randn(32, 16).astype(np.float32)
+    w = np.random.RandomState(71).randn(16, 8).astype(np.float32)
+    b = bolt.array(x, mesh)
+    hi = b.dot(w)
+    fast = b.dot(w, precision="default")
+    ref = x @ w
+    assert np.allclose(np.asarray(hi.toarray()), ref, rtol=1e-6, atol=1e-6)
+    assert np.allclose(np.asarray(fast.toarray()), ref, rtol=3e-2, atol=3e-2)
+    # distinct precisions are distinct compiled programs
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    assert sum(1 for k in _JIT_CACHE
+               if k[0] == "dot" and k[1] == (32, 16)) >= 2
