@@ -461,6 +461,8 @@ def bincount(b, minlength=0):
         raise TypeError("bincount requires an integer array, got %s"
                         % (b.dtype,))
     minlength = int(minlength)
+    if minlength < 0:
+        raise ValueError("'minlength' must not be negative")
     if b.size == 0:
         return np.zeros(minlength, np.int64)   # numpy's empty contract
     if b.mode == "local":

@@ -27,6 +27,12 @@ def histogram(b, bins=10, range=None, density=False):
         raise ValueError("bins must be >= 1, got %d" % bins)
     if range is not None:
         lo, hi = float(range[0]), float(range[1])
+        if not (np.isfinite(lo) and np.isfinite(hi)):
+            # numpy's rejection; NaN bounds would sail through the
+            # ordering checks (all NaN comparisons are False) and return
+            # garbage counts on the device path
+            raise ValueError(
+                "supplied range of [%s, %s] is not finite" % (lo, hi))
         if lo > hi:
             raise ValueError("range must satisfy min <= max, got %r"
                              % (range,))

@@ -353,6 +353,26 @@ def _dot(a, b, out=None):
     return a.dot(b)
 
 
+@_implements(np.histogram)
+def _histogram(a, bins=10, range=None, density=False, weights=None):
+    _require_default(weights=(weights, None))
+    if not isinstance(bins, (int, np.integer)):
+        raise _Fallback("bin edges")        # array edges: host path
+    from bolt_tpu.ops import histogram as bolt_histogram
+    return bolt_histogram(a, bins=bins, range=range, density=density)
+
+
+@_implements(np.bincount)
+def _bincount(a, weights=None, minlength=0):
+    _require_default(weights=(weights, None))
+    if a.ndim != 1:
+        # numpy's exact rejection; ops.bincount flattens, which would
+        # silently diverge from the local backend here
+        raise ValueError("object too deep for desired array")
+    from bolt_tpu.ops import bincount as bolt_bincount
+    return bolt_bincount(a, minlength=minlength)
+
+
 @_implements(np.shape)
 def _shape(a):
     return a.shape

@@ -230,6 +230,37 @@ def test_shape_ndim_size(mesh):
     assert np.size(b, 1) == 6
 
 
+def test_np_histogram_and_bincount(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    c, e = np.histogram(b, bins=8)
+    cn, en = np.histogram(x, bins=8)
+    assert np.array_equal(c, cn) and np.allclose(e, en)
+    c2, e2 = np.histogram(b, bins=6, range=(-1, 1), density=True)
+    cn2, en2 = np.histogram(x, bins=6, range=(-1, 1), density=True)
+    assert np.allclose(c2, cn2) and np.allclose(e2, en2)
+    # explicit bin-edge arrays fall back to the host path, same answer
+    edges = np.linspace(-2, 2, 5)
+    c3, e3 = np.histogram(b, bins=edges)
+    cn3, _ = np.histogram(x, bins=edges)
+    assert np.array_equal(c3, cn3)
+    iv = bolt.array((np.abs(x[0]) * 4).astype(np.int64).ravel(), mesh)
+    ivn = (np.abs(x[0]) * 4).astype(np.int64).ravel()
+    assert np.array_equal(np.bincount(iv), np.bincount(ivn))
+    assert np.array_equal(np.bincount(iv, minlength=20),
+                          np.bincount(ivn, minlength=20))
+    # 2-d input: numpy's exact error on both backends
+    with pytest.raises(ValueError):
+        np.bincount(bolt.array((np.abs(x) * 4).astype(np.int64), mesh))
+    # numpy's edge-case rejections hold on the device path too
+    with pytest.raises(ValueError, match="negative"):
+        np.bincount(iv, minlength=-1)
+    with pytest.raises(ValueError, match="finite"):
+        np.histogram(b, bins=4, range=(np.nan, np.nan))
+    with pytest.raises(ValueError, match="finite"):
+        np.histogram(b, bins=4, range=(0.0, np.inf))
+
+
 def test_np_unique_and_dot(mesh):
     x = np.floor(_x() * 2)
     b = bolt.array(x, mesh)
