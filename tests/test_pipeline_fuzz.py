@@ -175,10 +175,49 @@ def _op_keys_reshape(draw, b, x):
             x.reshape((d, n // d) + x.shape[1:]))
 
 
+def _op_set(draw, b, x):
+    # round-3 functional mutation: assign a scalar into a leading-axis
+    # record; the oracle copies (set never mutates)
+    if x.shape[0] < 1:
+        return b, x
+    i = draw(st.integers(0, x.shape[0] - 1))
+    c = draw(st.sampled_from([-3.0, 0.0, 7.5]))
+    x2 = x.copy()
+    x2[i] = c
+    return b.set(i, c), x2
+
+
+def _op_with_keys(draw, b, x):
+    # round-3 deferred with_keys chain entry
+    if b.split < 1:
+        return b, x
+    # keys match x's dtype: numpy's array-array promotion would lift an
+    # f32 oracle to f64 (int64 keys) while the device stays f32, pushing
+    # the terminal parity check onto the wrong tolerance branch
+    keys = np.arange(x.shape[0]).reshape(
+        (-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    return (b.map(lambda kv: kv[1] + kv[0][0], with_keys=True),
+            x + keys)
+
+
+def _op_np_sort(draw, b, x):
+    # round-3 __array_function__: functional np.sort on device
+    return np.sort(b, axis=-1), np.sort(x, axis=-1)
+
+
+def _op_take0(draw, b, x):
+    if x.shape[0] < 2:
+        return b, x
+    n = x.shape[0]
+    ids = draw(st.lists(st.integers(-n, n - 1), min_size=1, max_size=4))
+    return b.take(ids, axis=0), x.take(ids, axis=0)
+
+
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
         _op_concat_self, _op_keys_reshape, _op_smooth, _op_normalize,
-        _op_clip, _op_ufunc, _op_matmul]
+        _op_clip, _op_ufunc, _op_matmul, _op_set, _op_with_keys,
+        _op_np_sort, _op_take0]
 
 
 # ----------------------------------------------------------------------

@@ -134,6 +134,32 @@ assert cu.dtype == np.int64 and cu.sum() == x32.size
 uu = unique(bolt.array(np.floor(x64 * 2), mesh))
 assert np.array_equal(uu, np.unique(np.floor(x32 * 2)))
 
+# round-3 surfaces under f32-only production mode
+bs = bolt.array(x64, mesh)
+st = bs.set((0, slice(None), [0, 2]), 9.0)
+assert st.dtype == np.float32
+xs = x32.copy(); xs[0][:, [0, 2]] = 9.0
+assert np.allclose(st.toarray(), xs)
+srt = bolt.array(x64, mesh)
+assert srt.sort(axis=1) is None and srt.dtype == np.float32
+assert np.allclose(srt.toarray(), np.sort(x32, axis=1))
+ns = np.sum(bs)                              # np dispatch, device-served
+assert ns.mode == "tpu" and np.asarray(ns.toarray()).dtype == np.float32
+vq = bs.quantile([0.25, 0.75])
+assert vq.dtype == np.float32
+assert np.allclose(np.asarray(vq.toarray()),
+                   np.quantile(x32, [0.25, 0.75], axis=0), atol=1e-6)
+nz = bs.map(lambda v: (v > 1.5).astype(np.int32)).nonzero()
+assert all(i.dtype == np.int64 for i in nz)
+assert np.array_equal(np.stack(nz, 1),
+                      np.stack((x32 > 1.5).nonzero(), 1))
+sm2 = smooth(bs, 3, axis=(0, 1))             # sepfilter kernel path
+assert sm2.dtype == np.float32
+lo2 = smooth(bolt.array(x32), 3, axis=(0, 1))
+assert np.allclose(sm2.toarray(), lo2.toarray(), rtol=1e-5, atol=1e-6)
+tgt = np.empty(bs.shape, np.float32)
+assert bs.toarray(out=tgt) is tgt and np.array_equal(tgt, x32)
+
 print("X64-OFF-OK")
 """
 
