@@ -221,6 +221,44 @@ def test_new_stats_on_pending_filter(mesh):
     assert allclose(f.prod().toarray(), keep.prod(axis=0))
 
 
+def test_round3_ops_on_pending_filter_results(mesh):
+    # the round-3 surface resolves a PENDING filter result transparently
+    # too: set / in-place sort / np-dispatch / item / iter_shards / repeat
+    x = np.random.RandomState(91).randn(16, 4, 6)
+    keep = x[x.reshape(16, -1).mean(axis=1) > 0]
+    n = keep.shape[0]
+    assert 2 <= n < 16
+
+    def pending():
+        b = bolt.array(x, mesh).filter(lambda v: v.mean() > 0)
+        assert b.pending
+        return b
+
+    out = pending().set(0, 0.0)
+    expect = keep.copy()
+    expect[0] = 0.0
+    assert allclose(out.toarray(), expect)
+    srt = pending()
+    assert srt.sort(axis=0) is None
+    assert allclose(srt.toarray(), np.sort(keep, axis=0))
+    s = np.sum(pending())
+    assert s.mode == "tpu"
+    assert np.allclose(float(np.asarray(s.toarray())), keep.sum())
+    assert abs(pending().item(2) - keep.reshape(-1)[2]) < 1e-12
+    walked = np.empty_like(keep)
+    for idx, blk in pending().iter_shards():
+        walked[idx] = blk
+    assert np.allclose(walked, keep)
+    assert allclose(pending().repeat(2, axis=1).toarray(),
+                    keep.repeat(2, axis=1))
+    assert allclose(pending().diagonal(0, 1, 2).toarray(),
+                    keep.diagonal(0, 1, 2))
+    got = pending().nonzero()
+    want = keep.nonzero()
+    assert len(got) == len(want)
+    assert all(np.array_equal(a, b) for a, b in zip(got, want))
+
+
 def test_new_ops_on_pending_filter_results(mesh):
     # a filter result is PENDING (survivor count unsynced) until its shape
     # is read; every round-2 op must resolve it transparently
