@@ -56,13 +56,10 @@ def segment_reduce(b, labels, num_segments=None, op="sum"):
     if op not in _OPS:
         raise ValueError("op must be one of %s, got %r" % (_OPS, op))
     from bolt_tpu.base import BoltArray
-    if isinstance(labels, BoltArray):
-        if labels.mode == "tpu":
-            if b.mode == "tpu":
-                b._check_mesh(labels, "segment_reduce labels")
-            labels = labels.tojax()
-        else:
-            labels = np.asarray(labels)
+    if b.mode == "tpu":
+        labels = b._coerce_bolt_operand(labels, "segment_reduce labels")
+    elif isinstance(labels, BoltArray):
+        labels = np.asarray(labels)
     device_labels = isinstance(labels, jax.Array) and b.mode == "tpu"
     if not device_labels:
         labels = np.asarray(labels)

@@ -1048,6 +1048,21 @@ class BoltArrayTPU(BoltArray):
                 pass
         return jnp.asarray(np.asarray(other))
 
+    def _coerce_bolt_operand(self, value, what):
+        """Unwrap a possibly-bolt operand for a compiled program: a
+        same-mesh TPU array passes through as its device data (foreign
+        meshes get :meth:`_check_mesh`'s loud rejection), a local array
+        gathers to host; anything else returns unchanged.  ONE home for
+        the contract shared by ``set``/``searchsorted``/
+        ``segment_reduce`` labels."""
+        from bolt_tpu.base import BoltArray
+        if isinstance(value, BoltArray):
+            if value.mode == "tpu":
+                self._check_mesh(value, what)
+                return value.tojax()
+            return np.asarray(value)
+        return value
+
     def _check_mesh(self, other, what):
         """Binary ops take same-mesh operands only: silently constraining a
         foreign-mesh array to ``self``'s mesh would hide a (potentially
@@ -1571,9 +1586,7 @@ class BoltArrayTPU(BoltArray):
         if side not in ("left", "right"):
             raise ValueError(
                 "'%s' is an invalid value for keyword 'side'" % (side,))
-        from bolt_tpu.base import BoltArray
-        if isinstance(v, BoltArray):
-            v = v.tojax() if v.mode == "tpu" else np.asarray(v)
+        v = self._coerce_bolt_operand(v, "searchsorted values")
         varr = v if isinstance(v, jax.Array) else np.asarray(v)
         scalar = np.ndim(varr) == 0
         if sorter is not None:
@@ -1635,10 +1648,7 @@ class BoltArrayTPU(BoltArray):
         from bolt_tpu.utils import assignment_index, normalize_index
         norm, squeezed = normalize_index(index, self.shape)
         idx = assignment_index(norm, self.shape, squeezed)
-        from bolt_tpu.base import BoltArray
-        if isinstance(value, BoltArray):
-            value = value.tojax() if value.mode == "tpu" \
-                else np.asarray(value)
+        value = self._coerce_bolt_operand(value, "set value")
         val = value if isinstance(value, jax.Array) else np.asarray(value)
         # numpy assignment tolerates EXTRA leading length-1 dims on the
         # value (relative to the region, which drops scalar-indexed

@@ -262,6 +262,21 @@ def test_nonzero_two_phase_and_values(mesh):
         assert np.array_equal(a, b)
 
 
+def test_cross_mesh_operands_rejected(mesh):
+    # bolt-array operands from a foreign mesh get the loud binary-op
+    # rejection, not a deep GSPMD error (the _check_mesh contract)
+    import jax
+    x = _f()
+    b = bolt.array(x, mesh)
+    other_mesh = jax.make_mesh((4, 2), ("a", "b"))
+    foreign = bolt.array(np.zeros((4, 5)), other_mesh)
+    with pytest.raises(ValueError, match="different meshes"):
+        b.set(np.s_[0:1, 0:4], foreign)
+    s = bolt.array(np.sort(x.ravel()), mesh)
+    with pytest.raises(ValueError, match="different meshes"):
+        s.searchsorted(bolt.array(np.zeros(3), other_mesh))
+
+
 def test_searchsorted_sorter(mesh):
     x = np.random.RandomState(12).randn(16)
     order = np.argsort(x)
