@@ -321,15 +321,21 @@ def unique(b, return_counts=False):
 
     from bolt_tpu.tpu.array import (_CHUNK_MAX_BYTES, _cached_jit,
                                     _chain_apply, _check_live)
-    base, funcs = b._chain_parts()
-    split = b.split
-    mesh = b.mesh
     n = int(np.prod(b.shape))
     if n == 0:
         empty = np.empty(0, np.dtype(b.dtype))
         return (empty, np.empty(0, np.int64)) if return_counts else empty
+    # the sharded attempt runs BEFORE the chain parts are captured: it
+    # may materialise the chain (its gates need the concrete sharding),
+    # and capturing first would make the fallback re-run the chain
+    sharded = _unique_sharded(b, return_counts)
+    if sharded is not None:
+        return sharded
     if n * np.dtype(b.dtype).itemsize > _CHUNK_MAX_BYTES:
         return _unique_chunked(b, return_counts)
+    base, funcs = b._chain_parts()
+    split = b.split
+    mesh = b.mesh
 
     sorted_, mask, cnt = _cached_jit(
         ("unique-sort", funcs, base.shape, str(base.dtype), split, mesh),
@@ -348,46 +354,66 @@ def unique(b, return_counts=False):
     return uniq
 
 
-def _unique_phase1(funcs, split, start, stop):
-    """Phase-1 program: sort (a ``[start:stop)`` slice of) the flattened
-    chain output, first-occurrence mask — with numpy's NaN collapse:
+def _sort_mask(flat):
+    """Sorted values, first-occurrence mask — with numpy's NaN collapse:
     sorted NaNs are contiguous at the end, so "both NaN" marks
-    duplicates — and the mask count.  ONE builder for the whole-array
-    and chunked paths, so the mask semantics cannot drift."""
-    import jax
-    import jax.numpy as jnp
+    duplicates — and the mask count.  The ONE mask semantics shared by
+    the whole-array, chunked, and shard-local unique paths."""
+    flat = jnp.sort(flat)
+    neq = flat[1:] != flat[:-1]
+    if jnp.issubdtype(flat.dtype, jnp.floating):
+        neq &= ~(jnp.isnan(flat[1:]) & jnp.isnan(flat[:-1]))
+    mask = jnp.concatenate([jnp.ones(1, bool), neq])
+    return flat, mask, jnp.sum(mask, dtype=jnp.int32)
+
+
+def _gather_uniques(s, msk, m, size, return_counts):
+    """Gather ``size`` unique values (first-occurrence indices) out of
+    an ``m``-element sorted piece, with counts as index differences;
+    pad gathers clip to the last element and the host trims.  Counts
+    use the canonical int on device (int32 when x64 is off — no
+    warning); the host widens to int64 after the fetch.  Shared by
+    every unique path."""
+    idx = jnp.nonzero(msk, size=size, fill_value=m)[0]
+    uniq = jnp.take(s, idx, axis=0, mode="clip")
+    if not return_counts:
+        return (uniq,)       # skip the counts work and their transfer
+    ends = jnp.concatenate([idx[1:], jnp.asarray([m], idx.dtype)])
+    return uniq, (ends - idx).astype(
+        jax.dtypes.canonicalize_dtype(np.int64))
+
+
+def _merge_unique_parts(vals_parts, cnt_parts, return_counts):
+    """Exact host merge of per-piece uniques (+counts): the union of
+    piece uniques is the global unique set and counts add (np.unique's
+    NaN collapse maps every piece's NaN to one slot).  Shared by the
+    chunked and shard-local paths."""
+    allv = np.concatenate(vals_parts)
+    if not return_counts:
+        return np.unique(allv)
+    uniq, inv = np.unique(allv, return_inverse=True)
+    tot = np.zeros(len(uniq), np.int64)
+    np.add.at(tot, inv, np.concatenate(cnt_parts))
+    return uniq, tot
+
+
+def _unique_phase1(funcs, split, start, stop):
+    """Phase-1 program: :func:`_sort_mask` over (a ``[start:stop)``
+    slice of) the flattened chain output."""
     from bolt_tpu.tpu.array import _chain_apply
 
     def run(d):
         flat = _chain_apply(funcs, split, d).reshape(-1)
         if start is not None:
             flat = jax.lax.slice_in_dim(flat, start, stop)
-        flat = jnp.sort(flat)
-        neq = flat[1:] != flat[:-1]
-        if jnp.issubdtype(flat.dtype, jnp.floating):
-            neq &= ~(jnp.isnan(flat[1:]) & jnp.isnan(flat[:-1]))
-        mask = jnp.concatenate([jnp.ones(1, bool), neq])
-        return flat, mask, jnp.sum(mask, dtype=jnp.int32)
+        return _sort_mask(flat)
     return jax.jit(run)
 
 
 def _unique_phase2(m, size, return_counts):
-    """Phase-2 program: gather ``size`` unique values (first-occurrence
-    indices) out of an ``m``-element sorted piece, with counts as index
-    differences; pad gathers clip to the last element and the host
-    trims.  Counts use the canonical int on device (int32 when x64 is
-    off — no warning); the host widens to int64 after the fetch."""
-    import jax
-    import jax.numpy as jnp
-
+    """Phase-2 program: :func:`_gather_uniques` as its own jit."""
     def run(s, msk):
-        idx = jnp.nonzero(msk, size=size, fill_value=m)[0]
-        uniq = jnp.take(s, idx, axis=0, mode="clip")
-        if not return_counts:
-            return (uniq,)   # skip the counts work and their transfer
-        ends = jnp.concatenate([idx[1:], jnp.asarray([m], idx.dtype)])
-        return uniq, (ends - idx).astype(
-            jax.dtypes.canonicalize_dtype(np.int64))
+        return _gather_uniques(s, msk, m, size, return_counts)
     return jax.jit(run)
 
 
@@ -397,6 +423,87 @@ def _unique_phase2(m, size, return_counts):
 # (engages only when x64 is off AND the array is big enough to wrap);
 # tests set it small to force the chunked path.
 _BINCOUNT_CHUNK = None
+
+
+def _unique_sharded(b, return_counts):
+    """Shard-local ``unique`` for a multi-device array: ``shard_map``
+    sorts and masks each shard's OWN block (a global sort order is not
+    needed — any partition of the elements works for unique), per-shard
+    counts sync in one fetch, a second shard-local program gathers each
+    shard's uniques padded to a power of two, and the host merges
+    exactly — ZERO device collectives, where GSPMD's global 1-d sort
+    would all-gather the whole operand onto every device (the round-3
+    lowering probe).
+
+    Returns None (caller keeps the single-program / chunked paths) for
+    the layouts the simple formulation doesn't cover: single device,
+    multi-process (the per-shard outputs must be addressable), a
+    replicated dimension (per-shard counts would multiply), a
+    non-NamedSharding, or shards too big for their local sort transient.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+    from bolt_tpu.tpu.array import _CHUNK_MAX_BYTES, _cached_jit
+    # cheap gates FIRST — they must not materialise a deferred chain
+    # just to decline (single-device / multi-process layouts)
+    if b.mesh is None or b.mesh.size <= 1 or jax.process_count() > 1:
+        return None
+    data = b._data                          # chain materialises once
+    sharding = data.sharding
+    if not isinstance(sharding, NamedSharding):
+        return None
+    mesh = sharding.mesh
+    if not data.is_fully_addressable:
+        return None
+    used = []
+    for dim, entry in enumerate(sharding.spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        ways = int(np.prod([mesh.shape[u] for u in names]))
+        if data.shape[dim] % ways != 0:
+            return None                      # shard_map needs even splits
+        used.extend(names)
+    nshards = int(np.prod([mesh.shape[u] for u in used])) if used else 1
+    if nshards != mesh.size or nshards <= 1:
+        return None                          # replicated somewhere
+    local_elems = data.size // nshards
+    if local_elems == 0 \
+            or local_elems * data.dtype.itemsize > _CHUNK_MAX_BYTES:
+        return None
+    spec = sharding.spec
+    out_spec = PartitionSpec(tuple(used))
+
+    def p1_build():
+        def local(blk):
+            flat, mask, cnt = _sort_mask(blk.reshape(-1))
+            return flat[None], mask[None], cnt[None]
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=spec,
+            out_specs=(out_spec, out_spec, out_spec)))
+
+    sorted_, mask, cnt = _cached_jit(
+        ("unique-shard-sort", data.shape, str(data.dtype), spec, mesh),
+        p1_build)(data)
+    counts = np.asarray(jax.device_get(cnt))   # the one sync
+    kpad = 1 << max(0, (int(counts.max()) - 1).bit_length())
+
+    def p2_build():
+        def gather(s_ref, m_ref):
+            out = _gather_uniques(s_ref[0], m_ref[0], s_ref.shape[1],
+                                  kpad, return_counts)
+            return tuple(o[None] for o in out)
+        return jax.jit(jax.shard_map(
+            gather, mesh=mesh, in_specs=(out_spec, out_spec),
+            out_specs=(out_spec,) * (2 if return_counts else 1)))
+
+    out = jax.device_get(_cached_jit(
+        ("unique-shard-gather", data.shape, str(data.dtype), spec, kpad,
+         return_counts, mesh), p2_build)(sorted_, mask))
+    vals_parts = [np.asarray(out[0][i][:int(counts[i])])
+                  for i in range(nshards)]
+    cnt_parts = [np.asarray(out[1][i][:int(counts[i])]).astype(np.int64)
+                 for i in range(nshards)] if return_counts else None
+    return _merge_unique_parts(vals_parts, cnt_parts, return_counts)
 
 
 def _unique_chunked(b, return_counts):
@@ -435,13 +542,9 @@ def _unique_chunked(b, return_counts):
         vals_parts.append(np.asarray(out[0])[:k])
         if return_counts:
             cnt_parts.append(np.asarray(out[1])[:k].astype(np.int64))
-    allv = np.concatenate(vals_parts)
-    if not return_counts:
-        return np.unique(allv)
-    uniq, inv = np.unique(allv, return_inverse=True)
-    counts = np.zeros(len(uniq), np.int64)
-    np.add.at(counts, inv, np.concatenate(cnt_parts))
-    return uniq, counts
+    return _merge_unique_parts(vals_parts,
+                               cnt_parts if return_counts else None,
+                               return_counts)
 
 
 def bincount(b, minlength=0):

@@ -206,24 +206,31 @@ def test_bincount_lowers_to_all_reduce_no_gather(mesh):
     assert "all-gather" not in txt
 
 
-def test_unique_global_sort_gather_is_documented(mesh):
-    # KNOWN exception: unique's phase-1 is a GLOBAL 1-d sort, which
-    # GSPMD's partitioner only serves by coalescing the flat operand
-    # (verified: sharding constraints and an (n,1) reshape still lower
-    # to all-gather).  Accepted because (a) single-chip — the bench
-    # target — has no collective at all, and (b) above _CHUNK_MAX_BYTES
-    # the chunked path bounds every per-device transient.  This test
-    # pins the status quo so a partitioner improvement (or regression
-    # to something worse) is NOTICED.
+def test_unique_shard_local_is_collective_free(mesh):
+    # round-3: unique on a sharded input runs SHARD-LOCAL (per-shard
+    # sort/mask/gather via shard_map + exact host merge) — zero
+    # collectives, where GSPMD's global 1-d sort would all-gather the
+    # whole operand onto every device (measured; constraints and (n,1)
+    # reshapes don't help).  Layouts the shard-local gate declines
+    # (replicated dims, uneven splits, multi-process) fall back to the
+    # whole-array program, whose global-sort gather remains the one
+    # documented exception.
     from bolt_tpu.ops import unique
     from bolt_tpu.tpu import array as array_mod
     x = np.random.RandomState(14).randint(0, 7, size=(64, 4)).astype(float)
     b = bolt.array(x, mesh)
     assert np.array_equal(unique(b), np.unique(x))
-    fns = [v for k, v in array_mod._JIT_CACHE.items()
-           if k[0] == "unique-sort"]
-    txt = fns[-1].lower(b._data).compile().as_text()
+    for kind in ("unique-shard-sort", "unique-shard-gather"):
+        fns = [(k, v) for k, v in array_mod._JIT_CACHE.items()
+               if k[0] == kind]
+        assert fns, kind
+    (k1, f1) = [(k, v) for k, v in array_mod._JIT_CACHE.items()
+                if k[0] == "unique-shard-sort"][-1]
+    txt = f1.lower(b._data).compile().as_text()
     assert "sort" in txt
+    for coll in ("all-gather", "all-to-all", "all-reduce",
+                 "collective-permute"):
+        assert coll not in txt, coll
 
 
 def test_quantile_lowers_to_sorted_collective_program(mesh):
