@@ -286,9 +286,13 @@ def fused_welford(x, interpret=None):
     return tuple(v.astype(x.dtype) for v in (mu, m2, mn, mx))
 
 
-# windowing ALONG the minor (lane) axis compiles and beats the XLA
-# shifted-slice form up to this many taps; at 17 the lane-shift chain
-# crashes the Mosaic subprocess (measured; toolchain-specific)
+# windowing ALONG the minor (lane) axis: the lane-shift chain COMPILES
+# up to 13 taps (bisected: 11/13 OK, 15/17 crash the Mosaic subprocess
+# — toolchain-specific) but its throughput degrades with width; past 9
+# taps the swap-inland transpose detour measured faster end-to-end
+# (13-tap 2-axis gaussian: 93 ms direct vs 80 ms detour at 2.1 GB), so
+# the DIRECT minor path is capped at the performance crossover, not the
+# crash limit
 _MINOR_MAX_TAPS = 9
 
 

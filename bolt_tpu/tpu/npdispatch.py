@@ -353,6 +353,43 @@ def _dot(a, b, out=None):
     return a.dot(b)
 
 
+@_implements(np.where)
+def _where(condition, x=_NV, y=_NV):
+    if (x is _NV) != (y is _NV):
+        raise ValueError(
+            "either both or neither of x and y should be given")
+    if x is _NV:
+        # 1-arg form IS nonzero
+        if not _is_tpu(condition):
+            raise _Fallback("condition not on device")
+        return condition.nonzero()
+    import jax
+    import jax.numpy as jnp
+    from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit, _constrain
+    b = next((a for a in (condition, x, y) if _is_tpu(a)), None)
+    if b is None:
+        raise _Fallback("no device operand")
+    ops = [b._coerce_operand(b._coerce_bolt_operand(a, "where"))
+           for a in (condition, x, y)]
+    out_shape = np.broadcast_shapes(*(np.shape(o) for o in ops))
+    split = b.split
+    # keys survive only when no broadcast axis displaced them: same
+    # rank AND the leading dims still match b's key axes
+    new_split = split if (len(out_shape) == b.ndim
+                          and out_shape[:split] == b.shape[:split]) else 0
+    mesh = b.mesh
+
+    def build():
+        def run(c, xx, yy):
+            return _constrain(jnp.where(c, xx, yy), mesh, new_split)
+        return jax.jit(run)
+
+    fn = _cached_jit(("where",) + tuple(
+        (np.shape(o), str(getattr(o, "dtype", type(o).__name__)))
+        for o in ops) + (new_split, mesh), build)
+    return BoltArrayTPU(fn(*ops), new_split, mesh)
+
+
 @_implements(np.histogram)
 def _histogram(a, bins=10, range=None, density=False, weights=None):
     _require_default(weights=(weights, None))

@@ -230,6 +230,40 @@ def test_shape_ndim_size(mesh):
     assert np.size(b, 1) == 6
 
 
+def test_np_where(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    # 3-arg: device-served, bolt result, numpy broadcasting
+    out = np.where(b > 0, b, 0.0)
+    assert hasattr(out, "mode") and out.mode == "tpu" and out.split == 1
+    assert np.allclose(np.asarray(out.toarray()), np.where(x > 0, x, 0.0))
+    out2 = np.where(x > 1, b, b * -1.0)        # host cond + two device
+    assert np.allclose(np.asarray(out2.toarray()),
+                       np.where(x > 1, x, -x))
+    out3 = np.where(b[0] > 0, 1.0, np.arange(4.0))   # broadcast scalars
+    assert np.allclose(np.asarray(out3.toarray()),
+                       np.where(x[0] > 0, 1.0, np.arange(4.0)))
+    # 1-arg form IS nonzero
+    got = np.where(bolt.array((x > 1).astype(int), mesh))
+    want = np.where((x > 1).astype(int))
+    assert len(got) == len(want)
+    assert all(np.array_equal(a, b_) for a, b_ in zip(got, want))
+    with pytest.raises(ValueError, match="both or neither"):
+        np.where(b, 1.0)
+    # a broadcast-prepended axis displaces the keys: split drops to 0
+    # even when the leading sizes coincide (r3 review finding)
+    cond = np.ones((16, 16, 6, 4), bool)
+    out4 = np.where(cond, b, 0.0)
+    assert out4.shape == (16, 16, 6, 4) and out4.split == 0
+    assert np.allclose(np.asarray(out4.toarray()),
+                       np.where(cond, x, 0.0))
+    # foreign-mesh operand rejected loudly
+    import jax
+    other = bolt.array(x, jax.make_mesh((4, 2), ("a", "b")))
+    with pytest.raises(ValueError, match="different meshes"):
+        np.where(b > 0, b, other)
+
+
 def test_np_histogram_and_bincount(mesh):
     x = _x()
     b = bolt.array(x, mesh)

@@ -209,14 +209,14 @@ def test_sepfilter1d_gates():
                                [1.0], 0, interpret=True) is None
     assert kernels.sepfilter1d(jnp.ones((8, 100), jnp.float32),
                                [0.5, 0.5, 0.0], 0, interpret=True) is None
-    # minor-axis windows wider than the Mosaic-safe bound take the
-    # transpose detour when the second-minor dim is aligned...
-    wide = [1.0 / 11] * 11
+    # minor-axis windows wider than the direct-path crossover (9) take
+    # the transpose detour when the second-minor dim is aligned...
+    wide = [1.0 / 15] * 15
     x = jnp.asarray(np.random.RandomState(61).randn(4, 128, 256)
                     .astype(np.float32))
     got = kernels.sepfilter1d(x, wide, 2, interpret=True)
     assert got is not None
-    ap = np.pad(np.asarray(x), ((0, 0), (0, 0), (5, 5)))
+    ap = np.pad(np.asarray(x), ((0, 0), (0, 0), (7, 7)))
     expect = sum(ap[:, :, o:o + 256] * w for o, w in enumerate(wide))
     assert np.allclose(np.asarray(got), expect, rtol=1e-5, atol=1e-6)
     # ...and decline when it is not

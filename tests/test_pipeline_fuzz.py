@@ -342,7 +342,15 @@ def test_random_pipelines_match_numpy(mesh, data, seed, depth):
         if x.shape[0] == 0:
             break                        # filtered everything away
     assert b.shape == x.shape, (applied, b.shape, x.shape)
-    assert allclose(b.toarray(), x), applied
+    # dtype-aware tolerance: after an astype(f32) step, device and numpy
+    # transcendentals (tanh, …) differ by ~1 ulp and downstream affine
+    # steps amplify that past allclose's default rtol=1e-5/atol=1e-8
+    # (hypothesis found the seed); f64 chains keep the tight default
+    if x.dtype == np.float32:
+        assert np.allclose(np.asarray(b.toarray()), x,
+                           rtol=1e-4, atol=1e-5), applied
+    else:
+        assert allclose(b.toarray(), x), applied
     # and a terminal reduction agrees when records remain (dtype-aware
     # tolerance: f32 sums are ulp-close, not bit-exact, across different
     # summation orders — docs/DESIGN.md numerical-parity policy)
