@@ -366,9 +366,13 @@ def _where(condition, x=_NV, y=_NV):
     import jax
     import jax.numpy as jnp
     from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit, _constrain
-    b = next((a for a in (condition, x, y) if _is_tpu(a)), None)
-    if b is None:
+    devs = [a for a in (condition, x, y) if _is_tpu(a)]
+    if not devs:
         raise _Fallback("no device operand")
+    # anchor on the MOST-split device operand: anchoring on a
+    # replicated (split=0) condition would constrain the result
+    # replicated and all-gather a sharded x/y
+    b = max(devs, key=lambda a: a.split)
     ops = [b._coerce_operand(b._coerce_bolt_operand(a, "where"))
            for a in (condition, x, y)]
     out_shape = np.broadcast_shapes(*(np.shape(o) for o in ops))

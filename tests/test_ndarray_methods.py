@@ -16,20 +16,20 @@ import bolt_tpu as bolt
 
 
 def _f():
-    return np.random.RandomState(7).randn(6, 4, 5)
+    return np.random.RandomState(7).randn(8, 4, 5)
 
 
 def _i():
-    return np.random.RandomState(8).randint(-3, 4, size=(6, 4, 5))
+    return np.random.RandomState(8).randint(-3, 4, size=(8, 4, 5))
 
 
 def _i8():
-    return np.random.RandomState(9).randint(0, 3, size=(6, 4, 5)).astype(np.int8)
+    return np.random.RandomState(9).randint(0, 3, size=(8, 4, 5)).astype(np.int8)
 
 
 def _c():
     rs = np.random.RandomState(10)
-    return (rs.randn(6, 4, 5) + 1j * rs.randn(6, 4, 5))
+    return (rs.randn(8, 4, 5) + 1j * rs.randn(8, 4, 5))
 
 
 def _s():                           # sorted 1-d, for searchsorted
@@ -103,7 +103,7 @@ CASES = [
      lambda b: b.set(np.s_[1:3, 2], np.arange(5.0))),
     ("set-cast-truncates", _i, lambda b: b.set(0, 2.9)),
     ("set-bool-mask", _f,
-     lambda b: b.set((np.arange(6) % 2 == 0,), 0.0)),
+     lambda b: b.set((np.arange(8) % 2 == 0,), 0.0)),
     ("set-orthogonal", _f,
      lambda b: b.set(([0, 2], slice(None), [1, 3]),
                      np.arange(2 * 4 * 2.0).reshape(2, 4, 2))),
@@ -147,11 +147,21 @@ def _assert_same(name, lo, tp):
     assert np.allclose(an, bn, equal_nan=True), name
 
 
+@pytest.mark.parametrize("layout", ["keys1d", "keys2d"])
 @pytest.mark.parametrize("name,make,fn", CASES, ids=[c[0] for c in CASES])
-def test_method_parity(mesh, name, make, fn):
+def test_method_parity(request, layout, name, make, fn):
+    # every case runs on a split=1 array over the 1-d mesh AND a
+    # split=2 array genuinely sharded over both axes of the 2-d mesh —
+    # the method surface must be split-agnostic
+    if layout == "keys1d":
+        m, axis = request.getfixturevalue("mesh"), (0,)
+    else:
+        m, axis = request.getfixturevalue("mesh2d"), (0, 1)
     x = make()
+    if x.ndim < 2 and layout == "keys2d":
+        pytest.skip("1-d inputs have a single key axis")
     lo_status, lo = _run(fn, bolt.array(x.copy()))
-    tp_status, tp = _run(fn, bolt.array(x.copy(), mesh))
+    tp_status, tp = _run(fn, bolt.array(x.copy(), m, axis=axis))
     assert lo_status == tp_status, (name, lo, tp)
     if lo_status == "err":
         # same-error: identical class, or one a subclass of the other
@@ -295,7 +305,7 @@ def test_repeat_split_and_chain(mesh):
     assert t.split == 1 and t.shape == (x.size * 2,)
     # key-axis repeat keeps the split
     t = bolt.array(x, mesh).repeat(3, axis=0)
-    assert t.split == 1 and t.shape == (18, 4, 5)
+    assert t.split == 1 and t.shape == (24, 4, 5)
     # deferred chain fuses in
     m = bolt.array(x, mesh).map(lambda v: v + 1).repeat(2, axis=2)
     assert np.allclose(m.toarray(), (x + 1).repeat(2, axis=2))
