@@ -414,6 +414,55 @@ def _bincount(a, weights=None, minlength=0):
     return bolt_bincount(a, minlength=minlength)
 
 
+@_implements(np.split)
+def _split_fn(ary, indices_or_sections, axis=0):
+    return _do_split(ary, indices_or_sections, axis, strict=True)
+
+
+@_implements(np.array_split)
+def _array_split(ary, indices_or_sections, axis=0):
+    return _do_split(ary, indices_or_sections, axis, strict=False)
+
+
+def _do_split(ary, ios, axis, strict):
+    """numpy split semantics as device-served basic slices (each piece
+    is one compiled static-slice program through ``__getitem__``)."""
+    import operator
+    axis = int(axis)
+    dim = ary.shape[axis]
+    # numpy's own probe: sections-vs-indices is decided by len() — an
+    # unsized value (plain int, 0-d array, even a float, which numpy
+    # int()-coerces) is a SECTION COUNT; sized values are index lists
+    # whose entries must be true integers (numpy's slices raise
+    # TypeError for floats — operator.index mirrors that)
+    try:
+        nidx = len(ios)
+    except TypeError:
+        nidx = None
+    if nidx is None:
+        k = int(ios)              # numpy coerces float section counts
+        if k <= 0:
+            raise ValueError("number sections must be larger than 0.")
+        if strict and dim % k != 0:
+            raise ValueError(
+                "array split does not result in an equal division")
+        base, extra = divmod(dim, k)
+        sizes = [base + 1] * extra + [base] * (k - extra)
+        bounds = np.cumsum([0] + sizes)
+    else:
+        # raw indices: negative bounds wrap and oversized ones clamp
+        # through ordinary slice semantics, exactly like numpy's
+        # a[i:j] pieces (reversed pairs give empty pieces)
+        bounds = [0] + [operator.index(i)
+                        for i in np.asarray(ios).ravel().tolist()] + [dim]
+    out = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        sl = [slice(None)] * ary.ndim
+        sl[axis] = slice(int(lo), int(hi))
+        out.append(ary[tuple(sl)])
+    return out
+
+
 @_implements(np.shape)
 def _shape(a):
     return a.shape

@@ -230,6 +230,38 @@ def test_shape_ndim_size(mesh):
     assert np.size(b, 1) == 6
 
 
+def test_np_split(mesh):
+    x = _x()
+    b = bolt.array(x, mesh)
+    for args in [(4,), (2, 1), (np.array([3, 9]),), ([2, -4],),
+                 ([3, 1],)]:
+        got = np.split(b, *args) if len(args) == 1 \
+            else np.split(b, args[0], axis=args[1])
+        want = np.split(x, *args) if len(args) == 1 \
+            else np.split(x, args[0], axis=args[1])
+        assert len(got) == len(want), args
+        for g, w in zip(got, want):
+            assert hasattr(g, "mode") and g.mode == "tpu", args
+            assert np.allclose(np.asarray(g.toarray()), w), args
+    # strict split of a non-dividing count errors like numpy; the
+    # array_split form serves it
+    with pytest.raises(ValueError, match="equal division"):
+        np.split(b, 5)
+    got = np.array_split(b, 5)
+    want = np.array_split(x, 5)
+    assert [g.shape for g in got] == [w.shape for w in want]
+    for g, w in zip(got, want):
+        assert np.allclose(np.asarray(g.toarray()), w)
+    with pytest.raises(ValueError):
+        np.split(b, 0)
+    # numpy's probe semantics: a 0-d array is a SECTION count, float
+    # index entries raise like numpy's slices
+    got = np.split(b, np.array(4))
+    assert len(got) == 4 and got[0].shape[0] == 4
+    with pytest.raises(TypeError):
+        np.split(b, [2.5])
+
+
 def test_np_where(mesh):
     x = _x()
     b = bolt.array(x, mesh)
