@@ -294,6 +294,31 @@ def _swapaxes(a, axis1, axis2):
     return a.swapaxes(axis1, axis2)
 
 
+@_implements(np.moveaxis)
+def _moveaxis(a, source, destination):
+    from bolt_tpu.utils import inshape, tupleize
+    src = [s + a.ndim if s < 0 else s for s in tupleize(source)]
+    dst = [d + a.ndim if d < 0 else d for d in tupleize(destination)]
+    if len(src) != len(dst):
+        raise ValueError(
+            "`source` and `destination` arguments must have the same "
+            "number of elements")
+    if len(set(src)) != len(src) or len(set(dst)) != len(dst):
+        raise ValueError(
+            "repeated axis in `source` or `destination` argument")
+    inshape(a.shape, src)       # out-of-range (incl. doubly-negative)
+    inshape(a.shape, dst)       # raises instead of silently wrapping
+    rest = [i for i in range(a.ndim) if i not in src]
+    perm = [None] * a.ndim
+    for s, d in zip(src, dst):
+        perm[d] = s
+    it = iter(rest)
+    perm = [next(it) if p is None else p for p in perm]
+    # bolt's key/value boundary applies, like np.transpose: a move that
+    # crosses it raises the loud ValueError (use swap), never a gather
+    return a.transpose(*perm)
+
+
 @_implements(np.clip)
 def _clip(a, a_min=_NV, a_max=_NV, out=None, min=_NV, max=_NV, **kw):
     _require_default(out=(out, None))

@@ -25,6 +25,15 @@ from bolt_tpu.tpu.array import _cached_jit
 from bolt_tpu.utils import inshape, prod, tupleize
 
 
+def _kernel_gate(axes, ndim, dtype):
+    """ONE predicate for "the fused_welford kernel can engage here" —
+    shared by the traced body and welford's compile-failure fallback
+    arming, so they cannot disagree (jnp.issubdtype: bf16 IS floating,
+    where np.issubdtype says no)."""
+    return (axes == tuple(range(len(axes))) and len(axes) < ndim
+            and jnp.issubdtype(dtype, jnp.floating))
+
+
 def _shard_moments(x, axes, use_kernel=True):
     """Per-shard ``(mu, m2, min, max)`` over ``axes`` (traced inside the
     shard_map body).  When the reduced axes are the leading contiguous
@@ -34,8 +43,7 @@ def _shard_moments(x, axes, use_kernel=True):
     mean with the centred second moment, so it reads HBM twice;
     BASELINE.md).  Everything else takes the jnp path — identical
     semantics, allclose-level numerics."""
-    leading = axes == tuple(range(len(axes))) and len(axes) < x.ndim
-    if use_kernel and leading and jnp.issubdtype(x.dtype, jnp.floating):
+    if use_kernel and _kernel_gate(axes, x.ndim, x.dtype):
         from bolt_tpu.ops.kernels import fused_welford
         r = fused_welford(x)
         if r is not None:
@@ -123,10 +131,7 @@ def welford(barray, requested=("mean", "var", "std", "min", "max"),
     # their errors surface undisturbed (the sepfilter precedent: gate
     # eligibility BEFORE arming the fallback).
     data = barray._data
-    kernel_possible = (axes == tuple(range(len(axes)))
-                       and len(axes) < len(shape)
-                       and np.issubdtype(np.dtype(barray.dtype),
-                                         np.floating))
+    kernel_possible = _kernel_gate(axes, len(shape), barray.dtype)
     out = None
     if not kernel_possible:
         out = _cached_jit(key, build)(data)

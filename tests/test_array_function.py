@@ -53,6 +53,9 @@ DEVICE_CASES = [
     ("transpose", lambda a: np.transpose(a, (0, 2, 1))),
     ("squeeze", lambda a: np.squeeze(a[0:1])),
     ("swapaxes", lambda a: np.swapaxes(a, 1, 2)),
+    ("moveaxis", lambda a: np.moveaxis(a, 1, 2)),
+    ("moveaxis-neg", lambda a: np.moveaxis(a, -1, 1)),
+    ("moveaxis-multi", lambda a: np.moveaxis(a, (1, 2), (2, 1))),
     ("clip", lambda a: np.clip(a, -0.5, 0.5)),
     ("round", lambda a: np.round(a, 1)),
     ("real", lambda a: np.real(a)),
@@ -228,6 +231,18 @@ def test_shape_ndim_size(mesh):
     assert np.ndim(b) == 3
     assert np.size(b) == 384
     assert np.size(b, 1) == 6
+
+
+def test_np_moveaxis_validation(mesh):
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError):
+        np.moveaxis(b, 0, -4)            # doubly-negative destination
+    with pytest.raises(ValueError):
+        np.moveaxis(b, (0, 1), (0, 0))   # repeated destination
+    with pytest.raises(ValueError):
+        np.moveaxis(b, 5, 0)             # out-of-range source
+    with pytest.raises(ValueError):
+        np.moveaxis(b, (0, 1), (0,))     # length mismatch
 
 
 def test_np_split(mesh):
