@@ -294,6 +294,21 @@ def _swapaxes(a, axis1, axis2):
     return a.swapaxes(axis1, axis2)
 
 
+@_implements(np.flip)
+def _flip(m, axis=None):
+    from bolt_tpu.utils import inshape, tupleize
+    if axis is None:
+        axes = tuple(range(m.ndim))
+    else:
+        axes = tuple(a + m.ndim if a < 0 else a for a in tupleize(axis))
+        if len(set(axes)) != len(axes):
+            raise ValueError("repeated axis")
+        inshape(m.shape, axes)
+    sl = tuple(slice(None, None, -1) if i in axes else slice(None)
+               for i in range(m.ndim))
+    return m[sl]                 # one compiled reversed-slice program
+
+
 @_implements(np.moveaxis)
 def _moveaxis(a, source, destination):
     from bolt_tpu.utils import inshape, tupleize

@@ -53,6 +53,9 @@ DEVICE_CASES = [
     ("transpose", lambda a: np.transpose(a, (0, 2, 1))),
     ("squeeze", lambda a: np.squeeze(a[0:1])),
     ("swapaxes", lambda a: np.swapaxes(a, 1, 2)),
+    ("flip", lambda a: np.flip(a)),
+    ("flip-axis", lambda a: np.flip(a, 1)),
+    ("flip-neg-axis", lambda a: np.flip(a, (-1, 0))),
     ("moveaxis", lambda a: np.moveaxis(a, 1, 2)),
     ("moveaxis-neg", lambda a: np.moveaxis(a, -1, 1)),
     ("moveaxis-multi", lambda a: np.moveaxis(a, (1, 2), (2, 1))),
@@ -231,6 +234,14 @@ def test_shape_ndim_size(mesh):
     assert np.ndim(b) == 3
     assert np.size(b) == 384
     assert np.size(b, 1) == 6
+
+
+def test_np_flip_validation(mesh):
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError):
+        np.flip(b, 5)                   # out-of-range axis
+    with pytest.raises(ValueError):
+        np.flip(b, (1, -2))             # duplicate after normalization
 
 
 def test_np_moveaxis_validation(mesh):
