@@ -294,6 +294,29 @@ def _swapaxes(a, axis1, axis2):
     return a.swapaxes(axis1, axis2)
 
 
+@_implements(np.diff)
+def _diff(a, n=1, axis=-1, prepend=_NV, append=_NV):
+    _require_default(prepend=(prepend, _NV), append=(append, _NV))
+    import operator
+    n = operator.index(n)
+    if n < 0:
+        raise ValueError("order must be non-negative but got %d" % n)
+    axis = axis + a.ndim if axis < 0 else axis
+    from bolt_tpu.utils import inshape
+    inshape(a.shape, (axis,))
+    hi = tuple(slice(1, None) if i == axis else slice(None)
+               for i in range(a.ndim))
+    lo = tuple(slice(None, -1) if i == axis else slice(None)
+               for i in range(a.ndim))
+    boolean = np.dtype(a.dtype) == np.bool_
+    out = a
+    for _ in range(n):
+        # two slices + one elementwise program per order; numpy's bool
+        # diff is XOR (subtract rejects bool on both libraries)
+        out = (out[hi] != out[lo]) if boolean else out[hi] - out[lo]
+    return out
+
+
 @_implements(np.flip)
 def _flip(m, axis=None):
     from bolt_tpu.utils import inshape, tupleize

@@ -53,6 +53,9 @@ DEVICE_CASES = [
     ("transpose", lambda a: np.transpose(a, (0, 2, 1))),
     ("squeeze", lambda a: np.squeeze(a[0:1])),
     ("swapaxes", lambda a: np.swapaxes(a, 1, 2)),
+    ("diff", lambda a: np.diff(a)),
+    ("diff-axis0-n2", lambda a: np.diff(a, n=2, axis=0)),
+    ("diff-n0", lambda a: np.diff(a, n=0)),
     ("flip", lambda a: np.flip(a)),
     ("flip-axis", lambda a: np.flip(a, 1)),
     ("flip-neg-axis", lambda a: np.flip(a, (-1, 0))),
@@ -234,6 +237,22 @@ def test_shape_ndim_size(mesh):
     assert np.ndim(b) == 3
     assert np.size(b) == 384
     assert np.size(b, 1) == 6
+
+
+def test_np_diff_validation(mesh):
+    b = bolt.array(_x(), mesh)
+    with pytest.raises(ValueError, match="non-negative"):
+        np.diff(b, n=-1)
+    with pytest.raises(ValueError):
+        np.diff(b, axis=7)
+    # prepend/append aren't device-served: host fallback, same answer
+    got = np.diff(b, axis=0, prepend=0.0)
+    assert np.allclose(got, np.diff(_x(), axis=0, prepend=0.0))
+    # bool diff is XOR, like numpy (subtract rejects bool)
+    xb = _x() > 0
+    gb = np.diff(bolt.array(xb, mesh), axis=0)
+    assert gb.dtype == np.bool_
+    assert np.array_equal(np.asarray(gb.toarray()), np.diff(xb, axis=0))
 
 
 def test_np_flip_validation(mesh):
