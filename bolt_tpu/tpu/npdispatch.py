@@ -294,6 +294,15 @@ def _swapaxes(a, axis1, axis2):
     return a.swapaxes(axis1, axis2)
 
 
+@_implements(np.count_nonzero)
+def _count_nonzero(a, axis=None, keepdims=False):
+    # (a != 0) is a deferred mask entry; the int cast (astype
+    # canonicalises it) and the sum fuse with it into one program
+    mask = (a != 0) if np.dtype(a.dtype) != np.bool_ else a
+    return mask.astype(np.int64).sum(axis=_all_axes(a, axis),
+                                     keepdims=_keepdims(keepdims))
+
+
 @_implements(np.diff)
 def _diff(a, n=1, axis=-1, prepend=_NV, append=_NV):
     _require_default(prepend=(prepend, _NV), append=(append, _NV))

@@ -53,6 +53,8 @@ DEVICE_CASES = [
     ("transpose", lambda a: np.transpose(a, (0, 2, 1))),
     ("squeeze", lambda a: np.squeeze(a[0:1])),
     ("swapaxes", lambda a: np.swapaxes(a, 1, 2)),
+    ("count_nonzero", lambda a: np.count_nonzero(np.round(a))),
+    ("count_nonzero-axis", lambda a: np.count_nonzero(np.round(a), axis=1)),
     ("diff", lambda a: np.diff(a)),
     ("diff-axis0-n2", lambda a: np.diff(a, n=2, axis=0)),
     ("diff-n0", lambda a: np.diff(a, n=0)),
