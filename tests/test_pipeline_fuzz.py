@@ -302,7 +302,8 @@ def _lop_normalize(draw, b, x):
 # _op_operator/_op_slice0/_op_clip/_op_ufunc are backend-agnostic
 _LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _op_clip, _lop_filter,
               _lop_chunked_map, _lop_stacked_map, _lop_smooth,
-              _lop_concat_self, _lop_normalize, _op_ufunc, _lop_matmul]
+              _lop_concat_self, _lop_normalize, _op_ufunc, _lop_matmul,
+              _op_set, _op_np_sort, _op_take0]
 
 
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
