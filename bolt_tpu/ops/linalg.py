@@ -265,21 +265,33 @@ def _check_k(k, d):
     return k
 
 
-def _gram_decompose(x, k, xp, eigh_fn):
-    """Shared Gram-route core for the PCA family: ``x`` is ``(n, d)``,
-    returns ``(vec (d, k), ev (k,))`` in descending order.  ``xp`` is the
-    array namespace (numpy for the local oracle, jnp inside jit) so the
-    two backends run literally the same sequence."""
+def _gram(x, xp):
+    """The Gram matrix ``X^H X`` of ``(..., n, d)`` data — one MXU matmul
+    on TPU (highest precision, f32 accumulation)."""
     xt = xp.swapaxes(x, -1, -2)
     if xp.iscomplexobj(x):
         xt = xp.conj(xt)
-    g = xp.matmul(xt, x) if xp is np else \
+    return xp.matmul(xt, x) if xp is np else \
         xp.matmul(xt, x, precision="highest",
                   preferred_element_type=_acc_dtype(x.dtype))
+
+
+def _decompose_gram(g, k, xp, eigh_fn):
+    """Eigendecompose a Gram matrix: returns ``(vec (d, k), ev (k,))`` in
+    descending order with negative eigenvalues clamped to zero."""
     ev, vec = eigh_fn(g)                               # ascending
     ev = xp.maximum(ev[..., ::-1], 0.0)[..., :k]       # descending, clamped
     vec = vec[..., ::-1][..., :k]
     return vec, ev
+
+
+def _gram_decompose(x, k, xp, eigh_fn):
+    """Shared Gram-route core for the PCA family: ``x`` is ``(n, d)``,
+    returns ``(vec (d, k), ev (k,))`` in descending order.  ``xp`` is the
+    array namespace (numpy for the local oracle, jnp inside jit) so the
+    backends run the same sequence (the TPU pca program splices its
+    centering fold between :func:`_gram` and :func:`_decompose_gram`)."""
+    return _decompose_gram(_gram(x, xp), k, xp, eigh_fn)
 
 
 def _tpu_eigh(g):
@@ -447,7 +459,13 @@ def pca(b, k=None, center=False, axis=None, return_mean=False,
     data never gathers to one device or host.
 
     Parameters: ``b`` — a bolt array (TPU or local mode; locals run the
-    same math in NumPy); ``k`` — number of components (default: all
+    same Gram route in NumPy, except that with ``center=True`` the TPU
+    program folds the centering into the Gram algebraically
+    (``Gc = G - n mu mu^H`` — the centred matrix is never materialised)
+    while the oracle subtracts the mean explicitly: results agree to
+    ~``eps_f32 * (||mu||/sigma)^2`` relative — exact for mean-zero data,
+    ~1e-2 at a 200-sigma offset; pre-shift data with larger offsets);
+    ``k`` — number of components (default: all
     ``d``); ``center`` — subtract per-feature means first (adds one
     fused pass + a tiny psum); ``axis`` — the sample axes, like
     ``map``'s (default: the TPU array's key axes / axis 0 locally;
@@ -501,14 +519,31 @@ def pca(b, k=None, center=False, axis=None, return_mean=False,
         def program(data):
             mapped = _chain_apply(funcs, split, data)
             x = _widen(mapped.reshape((n, d)), jnp)
+            # Centering folds into the Gram algebraically (round-4 fusion):
+            #   (X - mu)^T (X - mu) = X^T X - n mu mu^T
+            # so the centred matrix is NEVER materialised — the raw X is
+            # read by exactly two MXU matmuls (Gram + projection) plus the
+            # mean's fused reduction, instead of a mean pass, a centred
+            # copy (read+write), and two matmuls over the copy.  The
+            # projection offset is applied to the (k,)-sized result:
+            #   (X - mu) @ V = X @ V - mu @ V.
+            # Conditioning: the fold loses the centred formulation's
+            # guard against cancellation when ||mu|| >> sigma — the Gram
+            # loses ~eps_f32 * (mu/sigma)^2 relative accuracy (measured:
+            # ~1e-4 at 20 sigma, ~1e-2 at 200 sigma — see
+            # test_pca_centering_fold_large_offset).  Pre-shift data with
+            # larger offsets.
             mu = jnp.mean(x, axis=0) if center else jnp.zeros(d, x.dtype)
+            g = _gram(x, jnp)
             if center:
-                x = x - mu
-            vec, ev = _gram_decompose(x, k, jnp, _tpu_eigh)
+                g = g - n * jnp.outer(jnp.conj(mu), mu)
+            vec, ev = _decompose_gram(g, k, jnp, _tpu_eigh)
             # precision="highest": the MXU's bf16 default costs ~3 decimal
             # digits on f32 data — visible in scores at PCA scale
-            scores = jnp.matmul(x, vec, precision="highest").reshape(
-                kshape + (k,))
+            scores = jnp.matmul(x, vec, precision="highest")
+            if center:
+                scores = scores - jnp.matmul(mu, vec, precision="highest")
+            scores = scores.reshape(kshape + (k,))
             scores = jax.lax.with_sharding_constraint(
                 scores, key_sharding(mesh, kshape + (k,), split))
             return scores, vec, jnp.sqrt(ev), mu
@@ -588,7 +623,11 @@ def cov(b, axis=None, center=True, ddof=1, return_mean=False):
     on the MXU and GSPMD all-reduces the (d, d) partial products — data
     never gathers.  ``ddof=1`` gives the sample covariance (numpy's
     ``np.cov`` default); ``center=False`` divides the raw second moment
-    ``X^T X`` by ``n - ddof`` instead.  Returns a (d, d) NumPy array;
+    ``X^T X`` by ``n - ddof`` instead.  Like :func:`pca`, the TPU
+    program folds the centering into the Gram algebraically (the local
+    oracle subtracts the mean explicitly) — entries lose
+    ~``eps_f32 * (||mu||/sigma)^2`` relative accuracy at large mean
+    offsets.  Returns a (d, d) NumPy array;
     ``return_mean=True`` appends the per-feature mean.  Superset of the
     reference (its ecosystem computes this via per-chunk jobs)."""
     mode, b, x_full, split, shape, n, d = _samples_features(b, axis, "cov")
@@ -614,13 +653,27 @@ def cov(b, axis=None, center=True, ddof=1, return_mean=False):
         def program(data):
             mapped = _chain_apply(funcs, split, data)
             x = _widen(mapped.reshape((n, d)), jnp)
+            # same centering fold as pca (round 4): the centred copy is
+            # never materialised — (X-mu)^T conj(X-mu) = X^T conj(X) -
+            # n mu conj(mu)^T; same second-factor conjugation as np.cov.
+            # Same conditioning envelope as pca's fold (~eps_f32 *
+            # (mu/sigma)^2 relative error in the entries).
             mu = jnp.mean(x, axis=0) if center else jnp.zeros(d, x.dtype)
-            if center:
-                x = x - mu
-            # same second-factor conjugation as the local path / np.cov
             c = jnp.matmul(jnp.swapaxes(x, -1, -2), jnp.conj(x),
-                           precision="highest") / (n - ddof)
-            return c, mu
+                           precision="highest",
+                           preferred_element_type=_acc_dtype(x.dtype))
+            if center:
+                c = c - n * jnp.outer(mu, jnp.conj(mu))
+                # the explicit-centering path this fold replaced computed
+                # Xc^H Xc, whose diagonal (sum of squared moduli) cannot
+                # go negative; the fold can cancel past f32 precision for
+                # tiny-variance features on a large offset, so restore
+                # the invariant (mirrors _decompose_gram's eigenvalue
+                # clamp) — corrcoef's sqrt(diag) depends on it
+                idx = jnp.arange(d)
+                diag = jnp.maximum(jnp.real(c[idx, idx]), 0.0)
+                c = c.at[idx, idx].set(diag.astype(c.dtype))
+            return c / (n - ddof), mu
         return jax.jit(program)
 
     fn = _cached_jit(("ops-cov", funcs, base.shape, str(base.dtype), split,

@@ -232,3 +232,82 @@ def test_pca_return_mean_projects_new_data(mesh):
     # local backend agrees
     _, _, _, mul = pca(bolt.array(x), k=2, center=True, return_mean=True)
     assert np.allclose(mul, mu, atol=1e-9)
+
+
+def test_pca_centering_fold_large_offset(mesh):
+    # Round-4 fusion folds centering into the Gram (Gc = G - n mu mu^T),
+    # which cancels when ||mu|| >> sigma: the Gram entries lose
+    # ~eps_f32 * (mu/sigma)^2 of relative accuracy (measured ~1e-2 at
+    # 200 sigma).  Pin that measured point so a change that degrades the
+    # fold's conditioning further fails loudly.
+    rs = np.random.RandomState(7)
+    x = (rs.randn(96, 5) + 200.0).astype(np.float32)
+    b = bolt.array(x, mesh, axis=(0,))
+    scores, comps, svals = pca(b, k=2, center=True)
+    rs_scores, rs_comps, rs_svals = _ref_pca(x, 2, center=True)
+    assert np.allclose(svals, rs_svals, atol=5e-2)
+    got = np.asarray(scores.toarray())
+    for i in range(2):
+        sign = np.sign(np.dot(comps[:, i], rs_comps[:, i])) or 1.0
+        assert np.allclose(sign * comps[:, i], rs_comps[:, i], atol=0.1)
+        assert np.allclose(sign * got[:, i], rs_scores[:, i], atol=0.2)
+
+
+def test_cov_centering_fold_large_offset(mesh):
+    from bolt_tpu.ops import cov
+    rs = np.random.RandomState(8)
+    x = (rs.randn(80, 4) + 200.0).astype(np.float32)
+    b = bolt.array(x, mesh, axis=(0,))
+    c = cov(b)
+    ref = np.cov(x.astype(np.float64), rowvar=False)
+    # fold cancellation at 200 sigma: ~eps_f32 * mu^2 / (n-1) ~ 1e-2
+    assert np.allclose(c, ref, atol=3e-2)
+
+
+def test_pca_tpu_complex_centered(mesh):
+    # the TPU centering fold's conjugations (G - n conj(mu) mu^T and the
+    # mu @ V projection offset) must match the explicitly-centred oracle:
+    # a flipped conj passes every real-valued test while scrambling
+    # complex spectra
+    rs = np.random.RandomState(9)
+    x = (rs.randn(64, 5) + 1j * rs.randn(64, 5)
+         + (2.0 - 1.0j)).astype(np.complex64)
+    b = bolt.array(x, mesh, axis=(0,))
+    scores, comps, svals = pca(b, k=3, center=True)
+    xc = x.astype(np.complex128)
+    xc = xc - xc.mean(axis=0)
+    expect = np.linalg.svd(xc, compute_uv=False)
+    assert np.allclose(svals, expect[:3], rtol=1e-3, atol=1e-3)
+    # scores must reproduce the centred projection: scores = Xc @ comps
+    got = np.asarray(scores.toarray())
+    assert np.allclose(got, xc @ comps, rtol=1e-3, atol=1e-3)
+
+
+def test_cov_tpu_complex_centered(mesh):
+    from bolt_tpu.ops import cov
+    rs = np.random.RandomState(10)
+    x = (rs.randn(48, 4) + 1j * rs.randn(48, 4)
+         + (1.0 + 2.0j)).astype(np.complex64)
+    b = bolt.array(x, mesh, axis=(0,))
+    c = cov(b)
+    # np.cov conjugates the SECOND factor (rowvar=False transposes)
+    xd = x.astype(np.complex128)
+    xc = xd - xd.mean(axis=0)
+    ref = (xc.T @ np.conj(xc)) / (len(xd) - 1)
+    assert np.allclose(c, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_cov_fold_diagonal_never_negative(mesh):
+    from bolt_tpu.ops import corrcoef, cov
+    # tiny variance on a huge offset: the fold's cancellation exceeds the
+    # true variance (~1e-6) in f32, which without the diagonal clamp went
+    # negative and NaN'd corrcoef's sqrt(diag)
+    rs = np.random.RandomState(11)
+    x = (rs.randn(64, 3) * 1e-3 + 30.0).astype(np.float32)
+    b = bolt.array(x, mesh, axis=(0,))
+    c = cov(b)
+    assert (np.diag(c) >= 0).all()
+    r = corrcoef(b)
+    # diag clamped to 0 makes those rows NaN by convention (np.corrcoef
+    # does the same for zero variance) — but no sqrt-of-negative warnings
+    assert r.shape == (3, 3)

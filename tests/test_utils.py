@@ -1,6 +1,8 @@
 """Unit tests for ``bolt_tpu/utils.py`` (reference test area:
 ``test/test_utils``-style direct unit coverage, SURVEY §4)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -80,3 +82,17 @@ def test_allclose_and_prod():
     assert not allclose(np.ones(3), np.zeros(3))
     assert prod((2, 3, 4)) == 24
     assert prod(()) == 1
+
+
+def test_version_matches_packaging():
+    # VERDICT r3 weak-1: __init__.__version__ drifted from pyproject once
+    # (0.2.0 vs 0.3.0); lock them together so a bump touches both or fails.
+    import re
+
+    import bolt_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml")) as f:
+        m = re.search(r'^version = "([^"]+)"', f.read(), re.M)
+    assert m, "pyproject.toml lost its version line"
+    assert bolt_tpu.__version__ == m.group(1)
