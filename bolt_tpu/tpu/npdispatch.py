@@ -15,6 +15,7 @@ Reference: the ndarray-native behavior of ``bolt/local/array.py``
 (symbol cite — SURVEY §0).
 """
 
+import operator
 import warnings
 
 import numpy as np
@@ -548,6 +549,600 @@ def _ndim(a):
 @_implements(np.size)
 def _size(a, axis=None):
     return a.size if axis is None else a.shape[axis]
+
+
+# ---------------------------------------------------------------------
+# fused multi-operand device programs (round 4, VERDICT r3 next-2):
+# the stack family, layout expanders, and contractions below all build
+# ONE compiled program over mixed bolt/host operands — deferred map
+# chains on bolt operands fuse in, host operands upload once, the
+# output carries a key-sharding constraint — instead of the warned
+# whole-array host gather they used to take.
+# ---------------------------------------------------------------------
+
+
+def _device_fused(tag, operands, anchor, new_split, body, extra_key):
+    """ONE compiled program over ``operands`` (bolt arrays fuse their
+    deferred chains; anything else is device-coerced once), computing
+    ``body(*mapped)`` with the result constrained to ``new_split``
+    leading key axes on the anchor's mesh.  ``extra_key`` must carry
+    every parameter ``body`` closes over — the executable cache is keyed
+    on it plus the per-operand (shape, dtype, chain, split) tuples."""
+    import jax
+    from bolt_tpu.tpu.array import (BoltArrayTPU, _cached_jit, _chain_apply,
+                                    _check_live, _constrain)
+    from bolt_tpu.base import BoltArray
+    mesh = anchor.mesh
+    parts = []
+    for op in operands:
+        if isinstance(op, BoltArrayTPU):
+            anchor._check_mesh(op, tag)
+            base, funcs = op._chain_parts()
+            parts.append((base, funcs, op.split))
+        else:
+            if isinstance(op, BoltArray):
+                op = np.asarray(op)         # local backend: host block
+            parts.append((anchor._coerce_operand(op), None, None))
+
+    def build():
+        def run(datas):
+            mapped = [_chain_apply(f, s, d) if f is not None else d
+                      for d, (_, f, s) in zip(datas, parts)]
+            return _constrain(body(*mapped), mesh, new_split)
+        return jax.jit(run)
+
+    key = (tag, mesh, new_split, extra_key,
+           tuple((tuple(b.shape), str(b.dtype), f, s) for b, f, s in parts))
+    out = _cached_jit(key, build)([_check_live(b) for b, _, _ in parts])
+    return BoltArrayTPU(out, new_split, mesh)
+
+
+def _require_tpu(a):
+    if not _is_tpu(a):
+        raise _Fallback("operand not on device")
+    return a
+
+
+def _aval_of(x):
+    import jax
+    dt = getattr(x, "dtype", None)
+    return jax.ShapeDtypeStruct(np.shape(x),
+                                np.dtype(dt) if dt is not None
+                                else np.result_type(x))
+
+
+# ---------------------------------------------------------------------
+# layout expanders
+# ---------------------------------------------------------------------
+
+def _expand_device(a, axes):
+    """Shared size-1-axis inserter (``expand_dims`` and the
+    ``atleast_*`` family): an axis inserted before the last key axis
+    joins the keys, one inserted at or past the key/value boundary
+    joins the values (the cheap side — no resharding)."""
+    import jax.numpy as jnp
+    out_ndim = a.ndim + len(axes)
+    norm = []
+    for ax in axes:
+        nx = ax + out_ndim if ax < 0 else ax
+        if not 0 <= nx < out_ndim:
+            raise np.exceptions.AxisError(ax, out_ndim)
+        norm.append(nx)
+    if len(set(norm)) != len(norm):
+        raise ValueError("repeated axis in `axis` argument")
+    ins = set(norm)
+    shape, new_split, nxt = [], 0, iter(range(a.ndim))
+    for p in range(out_ndim):
+        if p in ins:
+            shape.append(1)
+        else:
+            i = next(nxt)
+            shape.append(a.shape[i])
+            if i == a.split - 1:
+                new_split = p + 1
+    shape = tuple(shape)
+    return _device_fused("expand_dims", [a], a, new_split,
+                         lambda d: jnp.reshape(d, shape), (shape,))
+
+
+@_implements(np.expand_dims)
+def _expand_dims(a, axis):
+    _require_tpu(a)
+    from bolt_tpu.utils import tupleize
+    return _expand_device(a, tupleize(axis))
+
+
+def _one_atleast(a, n):
+    if not _is_tpu(a):
+        return getattr(np, "atleast_%dd" % n)(np.asarray(a))
+    if a.ndim >= n:
+        return a
+    # numpy's placement: atleast_2d prepends; atleast_3d gives a 1-d
+    # array (1, n, 1) and a 2-d one a trailing axis
+    missing = n - a.ndim
+    if n == 3 and a.ndim == 2:
+        axes = (2,)
+    elif n == 3 and a.ndim == 1:
+        axes = (0, 2)
+    else:
+        axes = tuple(range(missing))
+    return _expand_device(a, axes)
+
+
+@_implements(np.atleast_1d)
+def _atleast_1d(*arys):
+    res = [_one_atleast(a, 1) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+@_implements(np.atleast_2d)
+def _atleast_2d(*arys):
+    res = [_one_atleast(a, 2) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+@_implements(np.atleast_3d)
+def _atleast_3d(*arys):
+    res = [_one_atleast(a, 3) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+@_implements(np.broadcast_to)
+def _broadcast_to(array, shape, subok=False):
+    _require_tpu(array)
+    import jax.numpy as jnp
+    from bolt_tpu.utils import tupleize
+    shape = tuple(int(s) for s in tupleize(shape))
+    try:
+        out = np.broadcast_shapes(tuple(array.shape), shape)
+    except ValueError:
+        raise ValueError(
+            "cannot broadcast shape %s to %s"
+            % (str(tuple(array.shape)), str(shape))) from None
+    if out != shape:
+        # broadcast_to is one-directional: the target must BE the result
+        raise ValueError(
+            "cannot broadcast shape %s to %s"
+            % (str(tuple(array.shape)), str(shape)))
+    # prepended broadcast axes become leading key axes (keys lead by
+    # bolt's model; the constraint reshards over them)
+    new_split = array.split + (len(shape) - array.ndim) if array.split else 0
+    return _device_fused("broadcast_to", [array], array, new_split,
+                         lambda d: jnp.broadcast_to(d, shape), (shape,))
+
+
+@_implements(np.tile)
+def _tile(A, reps):
+    _require_tpu(A)
+    import jax.numpy as jnp
+    from bolt_tpu.utils import tupleize
+    rep_t = tuple(max(operator.index(r), 0) for r in tupleize(reps))
+    # reps longer than ndim prepends axes; they lead, so they join keys
+    new_split = A.split + max(0, len(rep_t) - A.ndim) if A.split else 0
+    return _device_fused("tile", [A], A, new_split,
+                         lambda d: jnp.tile(d, rep_t), (rep_t,))
+
+
+@_implements(np.roll)
+def _roll(a, shift, axis=None):
+    _require_tpu(a)
+    import jax.numpy as jnp
+    from bolt_tpu.utils import tupleize
+    sh_t = tuple(operator.index(s) for s in tupleize(shift))
+    if axis is not None:
+        ax_t = tuple(operator.index(x) for x in tupleize(axis))
+        for x in ax_t:
+            if not -a.ndim <= x < a.ndim:
+                raise np.exceptions.AxisError(x, a.ndim)
+    # an empty shift or axis tuple broadcasts to zero rolls: numpy
+    # returns the array unchanged (as a copy)
+    if len(sh_t) == 0 or (axis is not None and len(ax_t) == 0):
+        return a._clone()
+    if axis is None:
+        if len(sh_t) != 1:
+            raise _Fallback("vector shift with axis=None")
+        ax_arg = None
+        sh_arg = sh_t[0]
+    else:
+        if len(sh_t) != len(ax_t) and len(sh_t) != 1 and len(ax_t) != 1:
+            raise ValueError(
+                "'shift' and 'axis' should be scalars or 1D sequences")
+        ax_arg = ax_t if len(ax_t) > 1 or len(sh_t) > 1 else ax_t[0]
+        sh_arg = sh_t if len(sh_t) > 1 or len(ax_t) > 1 else sh_t[0]
+    return _device_fused("roll", [a], a, a.split,
+                         lambda d: jnp.roll(d, sh_arg, ax_arg),
+                         (sh_arg, ax_arg))
+
+
+@_implements(np.rot90)
+def _rot90(m, k=1, axes=(0, 1)):
+    _require_tpu(m)
+    import jax.numpy as jnp
+    axes = tuple(axes)
+    if len(axes) != 2:
+        raise ValueError("len(axes) must be 2.")
+    a0 = axes[0] + m.ndim if axes[0] < 0 else axes[0]
+    a1 = axes[1] + m.ndim if axes[1] < 0 else axes[1]
+    if not (0 <= a0 < m.ndim and 0 <= a1 < m.ndim):
+        raise ValueError("Axes=%s out of range for array of ndim=%d."
+                         % (str(axes), m.ndim))
+    if a0 == a1:
+        raise ValueError("Axes must be different.")
+    k = operator.index(k) % 4
+    split = m.split
+    if k % 2 and (a0 < split) != (a1 < split):
+        # odd rotations transpose the two axes — same boundary rule as
+        # transpose/moveaxis: never silently cross keys/values
+        raise ValueError(
+            "rot90 may not move axes between keys and values; use swap "
+            "(key axes: %s)" % str(tuple(range(split))))
+    if k == 0:
+        return m._clone()
+    return _device_fused("rot90", [m], m, split,
+                         lambda d: jnp.rot90(d, k=k, axes=(a0, a1)),
+                         (k, a0, a1))
+
+
+@_implements(np.pad)
+def _pad(array, pad_width, mode="constant", **kwargs):
+    _require_tpu(array)
+    import jax.numpy as jnp
+    allowed = {"constant": ("constant_values",), "edge": (),
+               "reflect": ("reflect_type",), "symmetric": ("reflect_type",),
+               "wrap": ()}
+    if callable(mode) or mode not in allowed:
+        raise _Fallback("mode")           # stat/ramp/callable: host path
+    unsupported = set(kwargs) - set(allowed[mode])
+    if unsupported:
+        raise ValueError("unsupported keyword arguments for mode '%s': %s"
+                         % (mode, unsupported))
+    pw = np.asarray(pad_width)
+    if not np.issubdtype(pw.dtype, np.integer):
+        raise TypeError("`pad_width` must be of integral type.")
+    try:
+        pairs = tuple(tuple(int(v) for v in row)
+                      for row in np.broadcast_to(pw, (array.ndim, 2)))
+    except ValueError:
+        raise ValueError(
+            "operands could not be broadcast together with shapes %s (%d, 2)"
+            % (str(pw.shape), array.ndim)) from None
+    if any(v < 0 for row in pairs for v in row):
+        raise ValueError("index can't contain negative values")
+    if mode == "constant":
+        cv = kwargs.get("constant_values", 0)
+        cv_key = tuple(map(tuple, np.broadcast_to(
+            np.asarray(cv), (array.ndim, 2)).tolist()))
+        kw, kw_key = {"constant_values": cv}, ("cv", cv_key)
+    elif mode in ("reflect", "symmetric"):
+        rt = kwargs.get("reflect_type", "even")
+        if rt not in ("even", "odd"):
+            raise ValueError("unsupported reflect_type '%s'" % (rt,))
+        kw, kw_key = {"reflect_type": rt}, ("rt", rt)
+    else:
+        kw, kw_key = {}, ()
+    return _device_fused("pad", [array], array, array.split,
+                         lambda d: jnp.pad(d, pairs, mode=mode, **kw),
+                         (pairs, mode, kw_key))
+
+
+# ---------------------------------------------------------------------
+# the stack family
+# ---------------------------------------------------------------------
+
+@_implements(np.stack)
+def _stack(arrays, axis=0, out=None, dtype=None, casting="same_kind"):
+    _require_default(out=(out, None), dtype=(dtype, None))
+    if casting != "same_kind":
+        raise _Fallback("casting")     # host path keeps numpy's TypeError
+    import jax.numpy as jnp
+    seq = list(arrays)
+    if not seq:
+        raise ValueError("need at least one array to stack")
+    if not _is_tpu(seq[0]):
+        raise _Fallback("first operand not on device")
+    a = seq[0]
+    if len({np.shape(s) for s in seq}) != 1:
+        raise ValueError("all input arrays must have the same shape")
+    out_ndim = a.ndim + 1
+    ax = axis + out_ndim if axis < 0 else axis
+    if not 0 <= ax < out_ndim:
+        raise np.exceptions.AxisError(axis, out_ndim)
+    # the new axis joins whichever group it lands in
+    new_split = a.split + 1 if ax < a.split else a.split
+    return _device_fused("stack", seq, a, new_split,
+                         lambda *ds: jnp.stack(ds, axis=ax), (ax,))
+
+
+def _stack_like(tag, tup, concat_axis, target_shape):
+    """vstack/hstack/column_stack/dstack: per-operand reshape (decided
+    eagerly from the host-known shapes) then ONE concatenate program.
+    ``target_shape(shape) -> tuple | None`` (None = pass through)."""
+    import jax.numpy as jnp
+    seq = list(tup)
+    if not seq:
+        raise ValueError("need at least one array to concatenate")
+    if not _is_tpu(seq[0]):
+        raise _Fallback("first operand not on device")
+    a = seq[0]
+    targets = [target_shape(np.shape(s)) for s in seq]
+    eff0 = targets[0] if targets[0] is not None else tuple(a.shape)
+    effs = [t if t is not None else np.shape(s)
+            for t, s in zip(targets, seq)]
+    ax = concat_axis(effs)
+    # numpy-exact cross-operand validation (a shape clash must be the
+    # documented ValueError, not a jax TypeError at trace time)
+    for i, e in enumerate(effs[1:], 1):
+        if len(e) != len(effs[0]):
+            raise ValueError(
+                "all the input arrays must have same number of dimensions, "
+                "but the array at index 0 has %d dimension(s) and the array "
+                "at index %d has %d dimension(s)"
+                % (len(effs[0]), i, len(e)))
+        for d in range(len(effs[0])):
+            if d != ax and e[d] != effs[0][d]:
+                raise ValueError(
+                    "all the input array dimensions except for the "
+                    "concatenation axis must match exactly, but along "
+                    "dimension %d, the array at index 0 has size %d and the "
+                    "array at index %d has size %d"
+                    % (d, effs[0][d], i, e[d]))
+    # an anchor reshaped up to 2-d/3-d keys its leading axis; one passed
+    # through keeps its own split
+    new_split = a.split if targets[0] is None else (
+        1 if len(eff0) >= 2 else a.split)
+
+    def body(*ds):
+        parts = [d if t is None else jnp.reshape(d, t)
+                 for d, t in zip(ds, targets)]
+        return jnp.concatenate(parts, axis=ax)
+
+    return _device_fused(tag, seq, a, new_split, body,
+                         (ax, tuple(targets)))
+
+
+@_implements(np.vstack)
+def _vstack(tup, *, dtype=None, casting="same_kind"):
+    _require_default(dtype=(dtype, None))
+    if casting != "same_kind":
+        raise _Fallback("casting")
+
+    def target(sh):
+        if len(sh) == 0:
+            return (1, 1)
+        if len(sh) == 1:
+            return (1, sh[0])
+        return None
+
+    return _stack_like("vstack", tup, lambda effs: 0, target)
+
+
+@_implements(np.hstack)
+def _hstack(tup, *, dtype=None, casting="same_kind"):
+    _require_default(dtype=(dtype, None))
+    if casting != "same_kind":
+        raise _Fallback("casting")
+
+    def target(sh):
+        return (1,) if len(sh) == 0 else None
+
+    # numpy: concatenate axis 0 when everything is 1-d, else axis 1
+    return _stack_like(
+        "hstack", tup,
+        lambda effs: 0 if all(len(e) == 1 for e in effs) else 1, target)
+
+
+@_implements(np.column_stack)
+def _column_stack(tup):
+    def target(sh):
+        if len(sh) == 0:
+            return (1, 1)
+        if len(sh) == 1:
+            return (sh[0], 1)
+        return None
+
+    return _stack_like("column_stack", tup, lambda effs: 1, target)
+
+
+@_implements(np.dstack)
+def _dstack(tup):
+    def target(sh):
+        if len(sh) == 0:
+            return (1, 1, 1)
+        if len(sh) == 1:
+            return (1, sh[0], 1)
+        if len(sh) == 2:
+            return sh + (1,)
+        return None
+
+    return _stack_like("dstack", tup, lambda effs: 2, target)
+
+
+@_implements(np.append)
+def _append(arr, values, axis=None):
+    _require_tpu(arr)
+    # numpy: axis=None ravels both operands; _concat_many does exactly
+    # that in one program
+    return arr._concat_many([values], axis)
+
+
+# ---------------------------------------------------------------------
+# contractions (MXU path — same "highest" precision policy as `dot`)
+# ---------------------------------------------------------------------
+
+def _contraction_anchor(*ops):
+    anchor = None
+    for o in ops:
+        if _is_tpu(o) and (anchor is None or o.split > anchor.split):
+            anchor = o
+    if anchor is None:
+        raise _Fallback("no device operand")
+    return anchor
+
+
+@_implements(np.einsum)
+def _einsum(*operands, out=None, optimize=False, **kwargs):
+    _require_default(out=(out, None), dtype=(kwargs.pop("dtype", None), None))
+    if kwargs.pop("order", "K") not in ("K", "C"):
+        raise _Fallback("order")
+    if kwargs.pop("casting", "safe") != "safe":
+        raise _Fallback("casting")
+    if kwargs:
+        raise _Fallback("einsum kwargs")
+    if not operands or not isinstance(operands[0], str):
+        raise _Fallback("interleaved einsum form")
+    import jax
+    import jax.numpy as jnp
+    subs = operands[0].replace(" ", "")
+    ops = list(operands[1:])
+    if "..." in subs:
+        raise _Fallback("ellipsis")
+    anchor = _contraction_anchor(*ops)
+    ins = subs.split("->")[0]
+    terms = ins.split(",")
+    if len(terms) != len(ops):
+        raise _Fallback("operand count mismatch")   # host raises exactly
+    try:
+        out_aval = jax.eval_shape(
+            lambda *xs: jnp.einsum(subs, *xs), *[_aval_of(o) for o in ops])
+    except TypeError as e:
+        raise ValueError(str(e)) from None
+    if "->" in subs:
+        outl = subs.split("->")[1]
+    else:
+        from collections import Counter
+        cnt = Counter(c for c in ins if c != ",")
+        outl = "".join(sorted(c for c in cnt if cnt[c] == 1))
+    aidx = next(i for i, o in enumerate(ops) if o is anchor)
+    term, split = terms[aidx], anchor.split
+    # keys survive when the anchor's key labels still lead the output,
+    # are not diagonalised within the anchor, and keep their sizes
+    new_split = split if (
+        len(term) == anchor.ndim
+        and len(set(term[:split])) == split
+        and outl[:split] == term[:split]
+        and tuple(out_aval.shape[:split]) == tuple(anchor.shape[:split])
+    ) else 0
+    return _device_fused(
+        "einsum", ops, anchor, new_split,
+        lambda *ds: jnp.einsum(subs, *ds, precision="highest"), (subs,))
+
+
+@_implements(np.tensordot)
+def _tensordot(a, b, axes=2):
+    import jax
+    import jax.numpy as jnp
+    from bolt_tpu.utils import tupleize
+    anchor = _contraction_anchor(a, b)
+    try:
+        k = operator.index(axes)
+        ax_a = tuple(range(np.ndim(a) - k, np.ndim(a)))
+        ax_b = tuple(range(k))
+    except TypeError:
+        axes_a, axes_b = axes
+        ax_a = tuple(operator.index(x) for x in tupleize(axes_a))
+        ax_b = tuple(operator.index(x) for x in tupleize(axes_b))
+    try:
+        out_aval = jax.eval_shape(
+            lambda x, y: jnp.tensordot(x, y, (ax_a, ax_b)),
+            _aval_of(a), _aval_of(b))
+    except TypeError as e:
+        raise ValueError(str(e)) from None
+    new_split = 0
+    if anchor is a:
+        pa = tuple(x + a.ndim if x < 0 else x for x in ax_a)
+        if all(x >= a.split for x in pa) and \
+                tuple(out_aval.shape[:a.split]) == tuple(a.shape[:a.split]):
+            new_split = a.split
+    return _device_fused(
+        "tensordot", [a, b], anchor, new_split,
+        lambda x, y: jnp.tensordot(x, y, (ax_a, ax_b),
+                                   precision="highest"), (ax_a, ax_b))
+
+
+@_implements(np.inner)
+def _inner(a, b):
+    import jax
+    import jax.numpy as jnp
+    anchor = _contraction_anchor(a, b)
+    try:
+        out_aval = jax.eval_shape(lambda x, y: jnp.inner(x, y),
+                                  _aval_of(a), _aval_of(b))
+    except TypeError as e:
+        raise ValueError(str(e)) from None
+    new_split = 0
+    if anchor is a:
+        cap = min(a.split, max(a.ndim - 1, 0))
+        if tuple(out_aval.shape[:cap]) == tuple(a.shape[:cap]):
+            new_split = cap
+    return _device_fused(
+        "inner", [a, b], anchor, new_split,
+        lambda x, y: jnp.inner(x, y, precision="highest"), ())
+
+
+@_implements(np.outer)
+def _outer(a, b, out=None):
+    _require_default(out=(out, None))
+    import jax.numpy as jnp
+    anchor = _contraction_anchor(a, b)
+    new_split = 1 if (anchor is a and a.split >= 1) else 0
+    return _device_fused("outer", [a, b], anchor, new_split,
+                         lambda x, y: jnp.outer(x, y), ())
+
+
+# ---------------------------------------------------------------------
+# statistics over samples x features (route to ops.linalg's one-pass
+# sharded Gram programs)
+# ---------------------------------------------------------------------
+
+@_implements(np.cov)
+def _cov(m, y=None, rowvar=True, bias=False, ddof=None, fweights=None,
+         aweights=None, *, dtype=None):
+    _require_default(y=(y, None), fweights=(fweights, None),
+                     aweights=(aweights, None), dtype=(dtype, None))
+    _require_tpu(m)
+    if m.ndim > 2:
+        raise ValueError("m has more than 2 dimensions")
+    if m.ndim == 0:
+        raise _Fallback("0-d")             # numpy warns and returns nan
+    if ddof is not None and ddof != int(ddof):
+        raise ValueError("ddof must be integer")
+    ddof = (0 if bias else 1) if ddof is None else int(ddof)
+    sample_axis = 0 if (m.ndim == 1 or not rowvar) else 1
+    if m.shape[sample_axis] - ddof <= 0:
+        raise _Fallback("non-positive dof")  # host path keeps the warning
+    from bolt_tpu.ops import cov as bolt_cov
+    c = bolt_cov(m, axis=(sample_axis,), ddof=ddof)
+    return c.reshape(()) if m.ndim == 1 else c
+
+
+@_implements(np.corrcoef)
+def _corrcoef(x, y=None, rowvar=True, bias=_NV, ddof=_NV, *, dtype=None):
+    # bias/ddof are accepted-and-ignored, exactly like numpy (deprecated
+    # no-ops there)
+    _require_default(y=(y, None), dtype=(dtype, None))
+    _require_tpu(x)
+    if x.ndim > 2:
+        raise ValueError("m has more than 2 dimensions")
+    if x.ndim == 0:
+        raise _Fallback("0-d")
+    sample_axis = 0 if (x.ndim == 1 or not rowvar) else 1
+    if x.shape[sample_axis] < 2:
+        raise _Fallback("too few samples")   # host path keeps the warning
+    from bolt_tpu.ops import corrcoef as bolt_corrcoef
+    r = bolt_corrcoef(x, axis=(sample_axis,))
+    # numpy clips the real and imaginary parts into [-1, 1] separately
+    if np.iscomplexobj(r):
+        r = np.clip(r.real, -1, 1) + 1j * np.clip(r.imag, -1, 1)
+    else:
+        r = np.clip(r, -1, 1)
+    return r.reshape(()) if x.ndim == 1 else r
+
+
+@_implements(np.copy)
+def _copy(a, order="K", subok=False):
+    if order not in ("K", "C"):
+        raise _Fallback("order")
+    return a._clone()
 
 
 # ---------------------------------------------------------------------

@@ -199,10 +199,10 @@ def test_unsupported_kwargs_fall_back_correctly(mesh):
     assert np.allclose(out, x.sum())
     # dtype= falls back and matches numpy exactly
     assert np.allclose(np.sum(b, dtype=np.float32), x.sum(dtype=np.float32))
-    # unhandled function (np.stack) → host path, numpy result
-    st = np.stack([b, b])
+    # unhandled function (np.partition) → host path, numpy result
+    st = np.partition(b, 2, axis=0)
     assert isinstance(st, np.ndarray)
-    assert np.allclose(st, np.stack([x, x]))
+    assert np.allclose(st, np.partition(x, 2, axis=0))
 
 
 def test_implicit_gather_warns_once_above_threshold(mesh, monkeypatch):
@@ -389,3 +389,266 @@ def test_np_unique_and_dot(mesh):
     d = np.dot(b, w)
     assert hasattr(d, "mode") and d.mode == "tpu"
     assert np.allclose(d.toarray(), x @ w)
+
+
+# ----------------------------------------------------------------------
+# round 4 (VERDICT r3 next-2): the dispatch tail — stack family, layout
+# expanders, contractions, cov/corrcoef.  Each case runs on a split=1
+# array over the 1-d mesh AND a split=2 array over the 2-d mesh; the
+# expectation is plain numpy on the host array.
+# ----------------------------------------------------------------------
+
+def _x2():
+    return np.random.RandomState(41).randn(8, 6, 4)
+
+
+TAIL_CASES = [
+    ("expand_dims", lambda a: np.expand_dims(a, 1)),
+    ("expand_dims-multi", lambda a: np.expand_dims(a, (0, -1))),
+    ("expand_dims-boundary", lambda a: np.expand_dims(a, 2)),
+    ("broadcast_to-prepend", lambda a: np.broadcast_to(a, (3,) + np.shape(a))),
+    ("broadcast_to-same", lambda a: np.broadcast_to(a, np.shape(a))),
+    ("tile-scalar", lambda a: np.tile(a, 2)),
+    ("tile-tuple", lambda a: np.tile(a, (2, 1, 3))),
+    ("tile-longer", lambda a: np.tile(a, (2, 1, 1, 2))),
+    ("roll-flat", lambda a: np.roll(a, 5)),
+    ("roll-axis", lambda a: np.roll(a, 3, axis=0)),
+    ("roll-multi", lambda a: np.roll(a, (1, -2), axis=(0, 2))),
+    ("roll-neg-axis", lambda a: np.roll(a, 2, axis=-1)),
+    ("rot90-values", lambda a: np.rot90(a, 1, axes=(1, 2))),
+    ("rot90-k2-cross", lambda a: np.rot90(a, 2, axes=(0, 2))),
+    ("rot90-k0", lambda a: np.rot90(a, 4, axes=(1, 2))),
+    ("pad-scalar", lambda a: np.pad(a, 2)),
+    ("pad-pairs", lambda a: np.pad(a, ((1, 2), (0, 1), (2, 0)))),
+    ("pad-const", lambda a: np.pad(a, 1, constant_values=7.5)),
+    ("pad-reflect", lambda a: np.pad(a, 2, mode="reflect")),
+    ("pad-reflect-odd", lambda a: np.pad(a, 2, mode="reflect",
+                                         reflect_type="odd")),
+    ("pad-symmetric", lambda a: np.pad(a, 1, mode="symmetric")),
+    ("pad-wrap", lambda a: np.pad(a, 3, mode="wrap")),
+    ("pad-edge", lambda a: np.pad(a, 2, mode="edge")),
+    ("stack-0", lambda a: np.stack([a, a])),
+    ("stack-mid", lambda a: np.stack([a, a, a], axis=2)),
+    ("stack-neg", lambda a: np.stack([a, a], axis=-1)),
+    ("vstack", lambda a: np.vstack([a, a])),
+    ("hstack", lambda a: np.hstack([a, a])),
+    ("dstack", lambda a: np.dstack([a, a])),
+    ("append-axis", lambda a: np.append(a, np.ones_like(np.asarray(a)),
+                                        axis=1)),
+    ("append-flat", lambda a: np.append(a, [1.0, 2.0])),
+    ("einsum-explicit", lambda a: np.einsum("ijk,ijk->ij", a, a)),
+    ("einsum-contract-keys", lambda a: np.einsum("ijk->k", a)),
+    ("einsum-implicit", lambda a: np.einsum("ijk,kl", a,
+                                            np.ones((4, 5)))),
+    ("einsum-transpose-out", lambda a: np.einsum("ijk->kji", a)),
+    ("tensordot-axes", lambda a: np.tensordot(
+        a, np.ones((6, 4, 3)), axes=([1, 2], [0, 1]))),
+    ("tensordot-int", lambda a: np.tensordot(a, np.ones((6, 4)), axes=2)),
+    ("inner-vec", lambda a: np.inner(a, np.arange(4.0))),
+    ("outer", lambda a: np.outer(a, np.arange(3.0))),
+    ("atleast-1d", lambda a: np.atleast_1d(a)),
+    ("atleast-3d", lambda a: np.atleast_3d(a)),
+    ("copy", lambda a: np.copy(a)),
+]
+
+
+@pytest.mark.parametrize("layout", ["keys1d", "keys2d"])
+@pytest.mark.parametrize("name,call", TAIL_CASES,
+                         ids=[c[0] for c in TAIL_CASES])
+def test_dispatch_tail_parity(request, layout, name, call):
+    if layout == "keys1d":
+        m, axis = request.getfixturevalue("mesh"), (0,)
+    else:
+        m, axis = request.getfixturevalue("mesh2d"), (0, 1)
+    x = _x2()
+    b = bolt.array(x, m, axis=axis)
+    if name == "rot90-values" and layout == "keys2d":
+        # on the split=2 layout axes (1, 2) straddle the key/value
+        # boundary: the odd rotation rejects like transpose does
+        with pytest.raises(ValueError, match="swap"):
+            call(b)
+        return
+    expect = call(x)
+    got = call(b)
+    g = np.asarray(got.toarray() if hasattr(got, "toarray") else got)
+    e = np.asarray(expect)
+    assert g.shape == e.shape, (name, g.shape, e.shape)
+    assert np.allclose(g, e, equal_nan=True), name
+
+
+@pytest.mark.parametrize("layout", ["keys1d", "keys2d"])
+def test_cov_corrcoef_parity(request, layout):
+    m = request.getfixturevalue("mesh" if layout == "keys1d" else "mesh2d")
+    axis = (0,) if layout == "keys1d" else (0, 1)
+    x = np.random.RandomState(42).randn(8, 6)
+    b = bolt.array(x, m, axis=axis)
+    assert np.allclose(np.cov(b), np.cov(x))
+    assert np.allclose(np.cov(b, rowvar=False), np.cov(x, rowvar=False))
+    assert np.allclose(np.cov(b, bias=True), np.cov(x, bias=True))
+    assert np.allclose(np.cov(b, ddof=0), np.cov(x, ddof=0))
+    assert np.allclose(np.corrcoef(b), np.corrcoef(x))
+    assert np.allclose(np.corrcoef(b, rowvar=False),
+                       np.corrcoef(x, rowvar=False))
+    # 1-d: 0-d result, like numpy
+    v = x[:, 0]
+    bv = bolt.array(v, m) if layout == "keys1d" else bolt.array(v, m)
+    assert np.shape(np.cov(bv)) == np.shape(np.cov(v)) == ()
+    assert np.allclose(np.cov(bv), np.cov(v))
+    assert np.allclose(np.corrcoef(bv), np.corrcoef(v))
+
+
+def test_dispatch_tail_stays_on_device(mesh, monkeypatch):
+    # the acceptance check for the round-4 tail: these calls may not
+    # gather — toarray/__array__ are booby-trapped
+    x = _x2()
+    b = bolt.array(x, mesh)
+    monkeypatch.setattr(
+        type(b), "toarray",
+        lambda self: (_ for _ in ()).throw(AssertionError("gathered!")))
+    monkeypatch.setattr(
+        type(b), "__array__",
+        lambda self, dtype=None: (_ for _ in ()).throw(
+            AssertionError("implicit __array__!")))
+    np.expand_dims(b, 0)
+    np.broadcast_to(b, (2, 8, 6, 4))
+    np.tile(b, (2, 1, 1))
+    np.roll(b, 3, axis=1)
+    np.rot90(b, axes=(1, 2))
+    np.pad(b, 1)
+    np.stack([b, b], axis=1)
+    np.vstack([b, b])
+    np.hstack([b, b])
+    np.dstack([b, b])
+    np.append(b, b, axis=0)
+    np.einsum("ijk,ijk->i", b, b)
+    np.tensordot(b, np.ones((4, 2)), axes=([2], [0]))
+    np.inner(b, np.ones(4))
+    np.outer(b, np.ones(3))
+    np.copy(b)
+    np.atleast_3d(b)
+
+
+def test_dispatch_tail_deferred_chains_fuse(mesh):
+    # a deferred map fuses into the tail's ONE compiled program and the
+    # original chain stays intact
+    x = _x2()
+    b = bolt.array(x, mesh).map(lambda v: v * 2.0)
+    out = np.stack([b, b], axis=0)
+    assert np.allclose(out.toarray(), np.stack([x * 2, x * 2], axis=0))
+    s = np.roll(b, 2, axis=0)
+    assert np.allclose(s.toarray(), np.roll(x * 2, 2, axis=0))
+    assert np.allclose(b.toarray(), x * 2)
+
+
+def test_dispatch_tail_rejections(mesh):
+    x = _x2()
+    b = bolt.array(x, mesh)
+    # numpy-exact rejections on the device path
+    with pytest.raises(ValueError, match="repeated axis"):
+        np.expand_dims(b, (0, 0))
+    with pytest.raises(np.exceptions.AxisError):
+        np.expand_dims(b, 9)
+    with pytest.raises(ValueError):
+        np.broadcast_to(b, (2, 2, 2))
+    with pytest.raises(np.exceptions.AxisError):
+        np.roll(b, 1, axis=5)
+    with pytest.raises(ValueError, match="must be different"):
+        np.rot90(b, axes=(1, 1))
+    with pytest.raises(ValueError, match="len\\(axes\\)"):
+        np.rot90(b, axes=(0, 1, 2))
+    with pytest.raises(ValueError, match="out of range"):
+        np.rot90(b, axes=(0, 5))
+    # odd rotations across the key/value boundary: the transpose rule
+    with pytest.raises(ValueError, match="swap"):
+        np.rot90(b, 1, axes=(0, 1))
+    # even rotations are pure flips — allowed across the boundary
+    assert np.allclose(np.rot90(b, 2, axes=(0, 1)).toarray(),
+                       np.rot90(x, 2, axes=(0, 1)))
+    with pytest.raises(ValueError, match="negative"):
+        np.pad(b, -1)
+    with pytest.raises(TypeError, match="integral"):
+        np.pad(b, 1.5)
+    with pytest.raises(ValueError, match="unsupported keyword"):
+        np.pad(b, 1, mode="edge", constant_values=3)
+    with pytest.raises(ValueError, match="same shape"):
+        np.stack([b, bolt.array(x[:4], mesh)])
+    with pytest.raises(np.exceptions.AxisError):
+        np.stack([b, b], axis=7)
+    with pytest.raises(ValueError, match="2 dimensions"):
+        np.cov(bolt.array(np.random.RandomState(1).randn(2, 3, 4), mesh))
+    with pytest.raises(ValueError, match="ddof"):
+        np.cov(bolt.array(np.random.RandomState(1).randn(4, 3), mesh),
+               ddof=1.5)
+
+
+def test_dispatch_tail_fallbacks_stay_correct(mesh):
+    # unsupported forms take the warned host path but remain
+    # numpy-correct
+    x = _x2()
+    b = bolt.array(x, mesh)
+    out = np.einsum("i...,i...->...", b, b)          # ellipsis: host
+    assert isinstance(out, np.ndarray)
+    assert np.allclose(out, np.einsum("i...,i...->...", x, x))
+    out2 = np.pad(b, 1, mode="mean")                 # stat mode: host
+    assert np.allclose(out2, np.pad(x, 1, mode="mean"))
+    out3 = np.pad(b, 1, mode="linear_ramp", end_values=2.0)
+    assert np.allclose(out3, np.pad(x, 1, mode="linear_ramp",
+                                    end_values=2.0))
+    # weighted cov: host path, numpy-exact
+    w = np.arange(1, 7)
+    out4 = np.cov(bolt.array(x[:, :, 0], mesh), fweights=w)
+    assert np.allclose(out4, np.cov(x[:, :, 0], fweights=w))
+
+
+def test_einsum_key_survival_and_mxu_policy(mesh, mesh2d):
+    # keys survive when the anchor's key labels lead the output
+    x = _x2()
+    b = bolt.array(x, mesh)
+    out = np.einsum("ijk,kl->ijl", b, np.ones((4, 3)))
+    assert out.split == 1
+    assert np.allclose(out.toarray(),
+                       np.einsum("ijk,kl->ijl", x, np.ones((4, 3))))
+    # keys contracted: re-keyed to split=0
+    out2 = np.einsum("ijk->jk", b)
+    assert out2.split == 0
+    # split=2 anchor over the 2-d mesh, both keys surviving
+    b2 = bolt.array(x, mesh2d, axis=(0, 1))
+    out3 = np.einsum("ijk,k->ij", b2, np.arange(4.0))
+    assert out3.split == 2
+    assert np.allclose(out3.toarray(), np.einsum("ijk,k->ij", x,
+                                                 np.arange(4.0)))
+
+
+def test_stack_family_split_bookkeeping(mesh, mesh2d):
+    x = _x2()
+    b = bolt.array(x, mesh)
+    assert np.stack([b, b], axis=0).split == 2     # new leading key axis
+    assert np.stack([b, b], axis=1).split == 1     # value-side insert
+    assert np.expand_dims(b, 0).split == 2
+    assert np.expand_dims(b, 1).split == 1         # at the boundary: value
+    assert np.broadcast_to(b, (2,) + x.shape).split == 2
+    assert np.tile(b, (3, 1, 1, 1)).split == 2
+    b2 = bolt.array(x, mesh2d, axis=(0, 1))
+    assert np.stack([b2, b2], axis=1).split == 3   # inserted among keys
+    assert np.roll(b2, 1, axis=0).split == 2
+
+
+def test_dispatch_tail_review_edges(mesh):
+    # round-4 review findings: numpy-exact edge behavior
+    x = _x2()
+    b = bolt.array(x, mesh)
+    # empty shift/axis tuples broadcast to zero rolls — unchanged copy
+    assert np.allclose(np.roll(b, 1, axis=()).toarray(),
+                       np.roll(x, 1, axis=()))
+    assert np.allclose(np.roll(b, (), axis=()).toarray(), x)
+    assert np.allclose(np.roll(b, (), axis=0).toarray(), x)
+    # stack-family shape clashes are numpy's ValueError, not a jax
+    # TypeError from inside the trace
+    with pytest.raises(ValueError, match="must match exactly"):
+        np.vstack([b, np.ones((3, 6, 4))[..., :3]])
+    with pytest.raises(ValueError, match="same number of dimensions"):
+        np.hstack([b, np.ones(3)])
+    # non-default casting routes to the host path so numpy's TypeError
+    # is preserved
+    with pytest.raises(TypeError, match="Cannot cast"):
+        np.stack([b.astype(np.float32), b], casting="no", dtype=np.float64)

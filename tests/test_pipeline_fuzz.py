@@ -213,11 +213,36 @@ def _op_take0(draw, b, x):
     return b.take(ids, axis=0), x.take(ids, axis=0)
 
 
+def _op_np_roll(draw, b, x):
+    # round-4 dispatch tail: shape-preserving device roll
+    ax = draw(st.integers(0, x.ndim - 1))
+    sh = draw(st.sampled_from([-2, 1, 3]))
+    return np.roll(b, sh, axis=ax), np.roll(x, sh, axis=ax)
+
+
+def _op_np_pad(draw, b, x):
+    # round-4 dispatch tail: one-program pad (value axes only, to keep
+    # the chain's key shape divisible states varied but valid)
+    if x.ndim < 2:
+        return b, x
+    mode = draw(st.sampled_from(["constant", "edge", "wrap"]))
+    w = draw(st.integers(1, 2))
+    pw = tuple((0, 0) if i < 1 else (w, w) for i in range(x.ndim))
+    return np.pad(b, pw, mode=mode), np.pad(x, pw, mode=mode)
+
+
+def _op_np_stack_self(draw, b, x):
+    # round-4 dispatch tail: rank-raising stack at a drawn position
+    ax = draw(st.integers(0, x.ndim))
+    return np.stack([b, b], axis=ax), np.stack([x, x], axis=ax)
+
+
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
         _op_concat_self, _op_keys_reshape, _op_smooth, _op_normalize,
         _op_clip, _op_ufunc, _op_matmul, _op_set, _op_with_keys,
-        _op_np_sort, _op_take0]
+        _op_np_sort, _op_take0, _op_np_roll, _op_np_pad,
+        _op_np_stack_self]
 
 
 # ----------------------------------------------------------------------
