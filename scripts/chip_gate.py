@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""One-command on-chip correctness gate (VERDICT r3 next-3).
+
+Runs the ``-m chip`` parity subset (``tests/test_chip.py``) against the
+REAL TPU with production numerics — x64 OFF, the actual XLA:TPU/Mosaic
+lowering — the configuration the CPU-mesh suite structurally cannot
+exercise.  Appends a one-line record to ``docs/STATUS.md`` so each
+round's run is auditable.
+
+Usage::
+
+    python scripts/chip_gate.py            # run + record
+    python scripts/chip_gate.py --no-record
+"""
+
+import datetime
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    env = dict(os.environ, BOLT_TEST_CHIP="1")
+    # the gate must see the real backend: strip the CPU-mesh overrides a
+    # caller's shell may carry
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "chip", "-q",
+         "tests/test_chip.py"],
+        cwd=ROOT, env=env, capture_output=True, text=True)
+    tail = (proc.stdout.strip().splitlines() or ["(no output)"])[-1]
+    print(proc.stdout[-4000:])
+    if proc.returncode != 0:
+        print(proc.stderr[-4000:], file=sys.stderr)
+    line = "- %s chip gate: %s (rc=%d)" % (
+        datetime.date.today().isoformat(), tail, proc.returncode)
+    print(line)
+    if "--no-record" not in sys.argv:
+        _record(line)
+    return proc.returncode
+
+
+HEADING = "## Chip gate runs"
+
+
+def _record(line):
+    """Append under a dedicated STATUS.md section (created on first
+    run) — a blind file append would land the record inside whatever
+    list happens to end the document."""
+    path = os.path.join(ROOT, "docs", "STATUS.md")
+    with open(path) as f:
+        text = f.read()
+    if HEADING not in text:
+        text = text.rstrip("\n") + "\n\n%s\n\n%s\n" % (HEADING, line)
+    else:
+        head, _, rest = text.partition(HEADING)
+        text = head + HEADING + rest.rstrip("\n") + "\n" + line + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
