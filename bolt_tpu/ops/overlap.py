@@ -166,7 +166,8 @@ def _whole_array_sepfilter(b, taps_key, axes, mode):
     itemsize = _np.dtype(b.dtype).itemsize
     if not _np.issubdtype(_np.dtype(b.dtype), _np.floating):
         return None
-    if not any(kernels.sepfilter_capable(b.shape, itemsize, g, len(t))
+    if not any(kernels.sepfilter_capable(b.shape, itemsize, g, len(t),
+                                         mode=mode)
                for g, t in active):
         return None
     mesh = b.mesh

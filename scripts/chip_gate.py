@@ -56,7 +56,14 @@ def _record(line):
         text = text.rstrip("\n") + "\n\n%s\n\n%s\n" % (HEADING, line)
     else:
         head, _, rest = text.partition(HEADING)
-        text = head + HEADING + rest.rstrip("\n") + "\n" + line + "\n"
+        # insert before the NEXT section heading, not at end-of-file —
+        # sections added below the gate log must not swallow records
+        nxt = rest.find("\n## ")
+        if nxt == -1:
+            text = head + HEADING + rest.rstrip("\n") + "\n" + line + "\n"
+        else:
+            text = (head + HEADING + rest[:nxt].rstrip("\n") + "\n" + line
+                    + "\n" + rest[nxt:])
     with open(path, "w") as f:
         f.write(text)
 

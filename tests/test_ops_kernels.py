@@ -210,7 +210,7 @@ def test_sepfilter1d_gates():
     assert kernels.sepfilter1d(jnp.ones((8, 100), jnp.float32),
                                [0.5, 0.5, 0.0], 0, interpret=True) is None
     # minor-axis windows wider than the direct-path crossover (9) take
-    # the transpose detour when the second-minor dim is aligned...
+    # the banded-matmul path (round 4)...
     wide = [1.0 / 15] * 15
     x = jnp.asarray(np.random.RandomState(61).randn(4, 128, 256)
                     .astype(np.float32))
@@ -219,12 +219,60 @@ def test_sepfilter1d_gates():
     ap = np.pad(np.asarray(x), ((0, 0), (0, 0), (7, 7)))
     expect = sum(ap[:, :, o:o + 256] * w for o, w in enumerate(wide))
     assert np.allclose(np.asarray(got), expect, rtol=1e-5, atol=1e-6)
-    # ...and decline when it is not
-    x2 = jnp.ones((4, 100, 256), jnp.float32)
-    assert kernels.sepfilter1d(x2, wide, 2, interpret=True) is None
-    # plan gating mirrors it
+    # ...which no longer needs the second-minor dim aligned (the old
+    # transpose detour did)
+    x2 = jnp.asarray(np.random.RandomState(62).randn(4, 100, 256)
+                     .astype(np.float32))
+    got2 = kernels.sepfilter1d(x2, wide, 2, interpret=True)
+    assert got2 is not None
+    ap2 = np.pad(np.asarray(x2), ((0, 0), (0, 0), (7, 7)))
+    exp2 = sum(ap2[:, :, o:o + 256] * w for o, w in enumerate(wide))
+    assert np.allclose(np.asarray(got2), exp2, rtol=1e-5, atol=1e-6)
+    # non-constant boundary modes keep the transpose detour, which DOES
+    # need the second-minor dim aligned — unaligned declines
+    assert kernels.sepfilter1d(x2, wide, 2, mode="reflect",
+                               interpret=True) is None
+    # an unaligned lane dim with an unaligned second-minor dim declines
+    # every path (band needs the lane 128-aligned, the detour needs the
+    # second-minor)
+    assert kernels.sepfilter1d(jnp.ones((4, 100, 250), jnp.float32),
+                               wide, 2, interpret=True) is None
+    # plan gating mirrors the direct-path cap
     assert kernels.sepfilter_plan((4, 128, 256), 4, 2, w=11) is None
     assert kernels.sepfilter_plan((4, 128, 256), 4, 2, w=9) is not None
+
+
+def test_lane_band_paths():
+    # the banded-matmul lane filter (round 4): pallas and XLA-conv
+    # forms vs the shifted-slice oracle, exact to machine precision
+    from bolt_tpu.ops import kernels
+    from bolt_tpu.ops.overlap import _filter1d
+    rs = np.random.RandomState(63)
+    for shape, w in [((4, 6, 256), 17), ((3, 128), 11), ((2, 256), 255)]:
+        x = rs.randn(*shape)
+        taps = tuple((rs.rand(w) / w).tolist())
+        want = _filter1d(x, len(shape) - 1, taps, "constant", np)
+        for fn in (lambda a: kernels.lane_band_pallas(a, taps,
+                                                      interpret=True),
+                   lambda a: kernels.lane_band_conv(a, taps)):
+            got = fn(jnp.asarray(x))
+            assert got is not None, (shape, w)
+            assert np.allclose(np.asarray(got), want, rtol=1e-12,
+                               atol=1e-12), (shape, w)
+    # refusals: unaligned lane dim, radius past one tile, int dtype
+    assert kernels.lane_band_pallas(jnp.ones((4, 100)), (0.5,) * 17,
+                                    interpret=True) is None
+    assert kernels.lane_band_conv(jnp.ones((4, 256)), (0.1,) * 259) is None
+    assert kernels.lane_band_conv(jnp.ones((4, 256), jnp.int32),
+                                  (1.0,) * 11) is None
+    # capability gate includes the band path — and is mode-aware, so it
+    # cannot disagree with what sepfilter1d actually accepts
+    assert kernels.sepfilter_capable((4, 100, 256), 4, 2, 17)
+    assert not kernels.sepfilter_capable((4, 100, 250), 4, 2, 17)
+    assert not kernels.sepfilter_capable((4, 100, 256), 4, 2, 17,
+                                         mode="reflect")
+    assert kernels.sepfilter_capable((4, 128, 256), 4, 2, 17,
+                                     mode="reflect")   # detour serves it
 
 
 def test_whole_array_sepfilter_failure_memo(mesh, monkeypatch):
