@@ -188,6 +188,39 @@ def worker(pid):
     print("worker %d OK" % pid, flush=True)
 
 
+def reload_worker(pid):
+    """Stage 2 (VERDICT r3 next-9): restore the stage-1 checkpoint with
+    a DIFFERENT process count — the written-by-N, read-by-M path (here
+    N=NPROC processes wrote it, one process with all devices reads it:
+    the common cluster-job → single-host-analysis flow)."""
+    nproc = int(os.environ["SMOKE_RELOAD_NPROC"])
+    devs = int(os.environ["SMOKE_RELOAD_DEVS"])
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=%d" % devs)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:%s" % os.environ["SMOKE_PORT2"],
+        num_processes=nproc, process_id=pid)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import numpy as np
+    from bolt_tpu import checkpoint
+    from bolt_tpu.parallel import make_mesh
+
+    ndev = nproc * devs
+    assert len(jax.devices()) == ndev, jax.devices()
+    mesh = make_mesh((ndev,), ("k",))
+    nkeys = int(os.environ["SMOKE_NKEYS"])
+    x = np.arange(nkeys * 6 * 4, dtype=np.float64).reshape(nkeys, 6, 4)
+    restored = checkpoint.load(os.environ["SMOKE_CKPT"], context=mesh)
+    assert restored.shape == (nkeys, 6, 4), restored.shape
+    assert np.allclose(restored.toarray(), x * 2 + 1)
+    # live on the new mesh, not just readable
+    assert np.allclose(restored.sum().toarray(), (x * 2 + 1).sum(axis=0))
+    print("reload worker %d OK" % pid, flush=True)
+
+
 def main():
     import tempfile
     env = dict(os.environ)
@@ -211,6 +244,28 @@ def main():
                 ok = False
                 print("--- worker %d FAILED (rc=%s) ---" % (pid, p.returncode))
                 print(text[-4000:])
+        # stage 2: the checkpoint written by NPROC processes restores in
+        # ONE process owning all the devices (differing process counts)
+        if ok:
+            env["SMOKE_PORT2"] = str(_free_port())
+            env["SMOKE_RELOAD_NPROC"] = "1"
+            env["SMOKE_RELOAD_DEVS"] = str(NPROC * DEVS_PER_PROC)
+            env["SMOKE_NKEYS"] = str(2 * NPROC * DEVS_PER_PROC)
+            rp = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--reload", "0"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            procs.append(rp)      # the finally cleanup must cover stage 2
+            try:
+                out, _ = rp.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                ok = False
+                out = b""
+                print("--- reload worker TIMED OUT ---")
+            text = out.decode(errors="replace")
+            if rp.returncode != 0 or "reload worker 0 OK" not in text:
+                ok = False
+                print("--- reload worker FAILED (rc=%s) ---" % rp.returncode)
+                print(text[-4000:])
     finally:
         # never orphan a worker holding the coordinator port
         for p in procs:
@@ -225,5 +280,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--worker":
         worker(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--reload":
+        reload_worker(int(sys.argv[2]))
     else:
         main()
