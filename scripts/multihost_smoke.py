@@ -27,17 +27,24 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def worker(pid):
+def _init_distributed(nproc, devs, port_env, pid):
+    """Shared per-process preamble (worker AND reload stages): the
+    platform must be forced to virtual CPU BEFORE any backend query, and
+    the coordinator joined before the repo import."""
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=%d" % DEVS_PER_PROC)
+                               + " --xla_force_host_platform_device_count=%d" % devs)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(
-        coordinator_address="127.0.0.1:%s" % os.environ["SMOKE_PORT"],
-        num_processes=NPROC, process_id=pid)
-
+        coordinator_address="127.0.0.1:%s" % os.environ[port_env],
+        num_processes=nproc, process_id=pid)
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return jax
+
+
+def worker(pid):
+    jax = _init_distributed(NPROC, DEVS_PER_PROC, "SMOKE_PORT", pid)
     import numpy as np
     import bolt_tpu as bolt
     from bolt_tpu.parallel import make_mesh
@@ -195,15 +202,7 @@ def reload_worker(pid):
     the common cluster-job → single-host-analysis flow)."""
     nproc = int(os.environ["SMOKE_RELOAD_NPROC"])
     devs = int(os.environ["SMOKE_RELOAD_DEVS"])
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=%d" % devs)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(
-        coordinator_address="127.0.0.1:%s" % os.environ["SMOKE_PORT2"],
-        num_processes=nproc, process_id=pid)
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    jax = _init_distributed(nproc, devs, "SMOKE_PORT2", pid)
     import numpy as np
     from bolt_tpu import checkpoint
     from bolt_tpu.parallel import make_mesh
