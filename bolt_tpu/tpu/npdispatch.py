@@ -1146,6 +1146,273 @@ def _copy(a, order="K", subok=False):
 
 
 # ---------------------------------------------------------------------
+# nan-aware reductions, norms, and sampling helpers (round 4, batch 2)
+# ---------------------------------------------------------------------
+
+def _axis_reduced_split(a, axes, keepdims):
+    """The canonical reduction split rule (``BoltArrayTPU._stat``):
+    ``keepdims`` keeps every key axis; otherwise reduced key axes drop
+    and the survivors stay leading.  ``axes`` must already be
+    normalized to non-negative ints (``_all_axes`` output)."""
+    if keepdims:
+        return a.split
+    norm = {ax + a.ndim if ax < 0 else ax
+            for ax in (axes if isinstance(axes, (tuple, list, set))
+                       else (axes,))}
+    return a.split - sum(1 for i in range(a.split) if i in norm)
+
+
+def _nan_reduction(name):
+    import jax.numpy as jnp
+    jfn = getattr(jnp, name)
+
+    def handler(a, axis=None, dtype=None, out=None, keepdims=_NV,
+                **kw):
+        _require_default(out=(out, None), dtype=(dtype, None),
+                         initial=(kw.pop("initial", _NV), _NV),
+                         where=(kw.pop("where", _NV), _NV))
+        ddof = kw.pop("ddof", 0)
+        mean_kw = kw.pop("mean", _NV)
+        correction = kw.pop("correction", _NV)
+        _require_default(mean=(mean_kw, _NV))
+        if kw:
+            raise _Fallback("%s kwargs" % name)
+        if correction is not _NV:
+            if ddof != 0:
+                raise ValueError("can't specify both correction and ddof")
+            ddof = correction
+        _require_tpu(a)
+        ax = _all_axes(a, axis)
+        kd = _keepdims(keepdims)
+        args = {"axis": ax, "keepdims": kd}
+        if name in ("nanvar", "nanstd"):
+            args["ddof"] = ddof
+        return _device_fused(name, [a], a, _axis_reduced_split(a, ax, kd),
+                             lambda d: jfn(d, **args), (ax, kd, ddof))
+    return handler
+
+
+for _name in ("nansum", "nanprod", "nanmean", "nanvar", "nanstd",
+              "nanmin", "nanmax"):
+    _TABLE[getattr(np, _name)] = _nan_reduction(_name)
+
+
+@_implements(np.nanmedian)
+def _nanmedian(a, axis=None, out=None, overwrite_input=False,
+               keepdims=_NV):
+    _require_default(out=(out, None))
+    _require_tpu(a)
+    import jax.numpy as jnp
+    ax, kd = _all_axes(a, axis), _keepdims(keepdims)
+
+    def body(d):
+        xf = d.astype(jnp.promote_types(d.dtype, jnp.float32))
+        return jnp.nanmedian(xf, axis=ax, keepdims=kd)
+
+    return _device_fused("nanmedian", [a], a,
+                         _axis_reduced_split(a, ax, kd), body, (ax, kd))
+
+
+@_implements(np.nanquantile)
+def _nanquantile(a, q, axis=None, out=None, overwrite_input=False,
+                 method="linear", keepdims=_NV, weights=None,
+                 interpolation=None):
+    _require_default(out=(out, None), weights=(weights, None),
+                     interpolation=(interpolation, None))
+    if method not in ("linear", "lower", "higher", "midpoint", "nearest"):
+        raise _Fallback("method")
+    _require_tpu(a)
+    import jax.numpy as jnp
+    from bolt_tpu.utils import check_q
+    qarr = check_q(q)                      # shared scalar/1-d contract
+    scalar_q = qarr.ndim == 0
+    qt = tuple(np.atleast_1d(qarr).tolist())
+    ax, kd = _all_axes(a, axis), _keepdims(keepdims)
+
+    def body(d):
+        # same promotion as BoltArrayTPU.quantile: integer data widens,
+        # q is cast to the promoted FLOAT dtype (int data used to crash
+        # the trace)
+        xf = d.astype(jnp.promote_types(d.dtype, jnp.float32))
+        qv = jnp.asarray(qt[0] if scalar_q else list(qt), dtype=xf.dtype)
+        return jnp.nanquantile(xf, qv, axis=ax, method=method,
+                               keepdims=kd)
+
+    # vector q prepends a flat KEY axis — the quantile-method
+    # convention — ahead of the surviving key axes
+    new_split = _axis_reduced_split(a, ax, kd) + (0 if scalar_q else 1)
+    return _device_fused("nanquantile", [a], a, new_split, body,
+                         (qt, scalar_q, ax, kd, method))
+
+
+@_implements(np.linalg.norm)
+def _linalg_norm(x, ord=None, axis=None, keepdims=False):
+    _require_tpu(x)
+    import jax.numpy as jnp
+    from bolt_tpu.utils import tupleize
+    ax = None if axis is None else tuple(
+        int(v) for v in tupleize(axis))
+    if ax is not None and len(ax) == 1:
+        ax = ax[0]
+    kd = bool(keepdims)
+    reduced = tuple(range(x.ndim)) if ax is None else (
+        (ax,) if np.isscalar(ax) else ax)
+    return _device_fused(
+        "linalg_norm", [x], x, _axis_reduced_split(x, reduced, kd),
+        lambda d: jnp.linalg.norm(d, ord=ord, axis=ax, keepdims=kd),
+        (str(ord), ax, kd))
+
+
+@_implements(np.average)
+def _average(a, axis=None, weights=None, returned=False, *,
+             keepdims=_NV):
+    _require_tpu(a)
+    import jax.numpy as jnp
+    ax = _all_axes(a, axis)
+    kd = _keepdims(keepdims)
+    if weights is None:
+        avg = a.mean(axis=ax, keepdims=kd)
+        if not returned:
+            return avg
+        n = 1
+        for i in (range(a.ndim) if axis is None else
+                  [axis] if np.isscalar(axis) else axis):
+            n *= a.shape[i]
+        # numpy returns the sum of weights broadcast to the result shape
+        scl = np.broadcast_to(np.asarray(float(n), avg.dtype),
+                              avg.shape).copy()
+        return avg, scl
+    if _is_tpu(weights):
+        raise _Fallback("bolt weights")    # host path handles mixed
+    w = np.asarray(weights)
+    if w.shape == tuple(a.shape):
+        wb = w
+    elif w.ndim == 1 and axis is not None and np.isscalar(axis):
+        axn = axis + a.ndim if axis < 0 else axis
+        if w.shape[0] != a.shape[axn]:
+            raise ValueError(
+                "Length of weights not compatible with specified axis.")
+        shape = [1] * a.ndim
+        shape[axn] = w.shape[0]
+        wb = w.reshape(shape)
+    else:
+        raise _Fallback("weights shape")
+    scl_full = np.broadcast_to(wb, tuple(a.shape)).sum(axis=None if
+                                                       axis is None else ax,
+                                                       keepdims=kd)
+    if np.any(scl_full == 0):
+        raise ZeroDivisionError(
+            "Weights sum to zero, can't be normalized")
+
+    def body(d, wj):
+        num = jnp.sum(d * wj, axis=ax, keepdims=kd)
+        den = jnp.sum(jnp.broadcast_to(wj, d.shape), axis=ax,
+                      keepdims=kd)
+        return num / den
+
+    avg = _device_fused("average", [a, wb], a,
+                        _axis_reduced_split(a, ax, kd), body,
+                        (ax, kd, wb.shape))
+    if not returned:
+        return avg
+    scl = np.broadcast_to(np.asarray(scl_full, avg.dtype),
+                          avg.shape).copy()
+    return avg, scl
+
+
+@_implements(np.isin)
+def _isin(element, test_elements, assume_unique=False, invert=False, *,
+          kind=None):
+    _require_default(kind=(kind, None))
+    _require_tpu(element)
+    import jax.numpy as jnp
+    if _is_tpu(test_elements):
+        test_elements = test_elements.tojax()
+    te = np.asarray(test_elements) if not hasattr(
+        test_elements, "dtype") else test_elements
+    return _device_fused(
+        "isin", [element, te], element, element.split,
+        lambda d, t: jnp.isin(d, t, assume_unique=assume_unique,
+                              invert=invert),
+        (bool(assume_unique), bool(invert)))
+
+
+@_implements(np.digitize)
+def _digitize(x, bins, right=False):
+    _require_tpu(x)
+    import jax.numpy as jnp
+    b = np.asarray(bins)
+    if b.ndim != 1:
+        raise ValueError("object too deep for desired array")
+    d = np.diff(b)
+    if len(b) > 1 and not (np.all(d > 0) or np.all(d < 0)):
+        raise ValueError(
+            "bins must be monotonically increasing or decreasing")
+    return _device_fused(
+        "digitize", [x, b], x, x.split,
+        lambda d, bb: jnp.digitize(d, bb, right=bool(right)),
+        (bool(right),))
+
+
+@_implements(np.interp)
+def _interp(x, xp, fp, left=None, right=None, period=None):
+    _require_tpu(x)
+    import jax.numpy as jnp
+    if _is_tpu(xp) or _is_tpu(fp):
+        raise _Fallback("bolt sample points")
+    xpa, fpa = np.asarray(xp), np.asarray(fp)
+    if xpa.ndim != 1 or fpa.ndim != 1:
+        raise ValueError("Data points must be 1-D sequences")
+    if len(xpa) != len(fpa):
+        raise ValueError("fp and xp are not of the same length")
+    if len(xpa) == 0:
+        raise ValueError("array of sample points is empty")
+    return _device_fused(
+        "interp", [x, xpa, fpa], x, x.split,
+        lambda d, xx, ff: jnp.interp(d, xx, ff, left=left, right=right,
+                                     period=period),
+        (left, right, period))
+
+
+@_implements(np.gradient)
+def _gradient(f, *varargs, axis=None, edge_order=1):
+    _require_tpu(f)
+    if edge_order != 1:
+        raise _Fallback("edge_order")
+    import jax.numpy as jnp
+    from bolt_tpu.utils import tupleize, inshape
+    if axis is None:
+        axes = tuple(range(f.ndim))
+    else:
+        axes = tuple(a + f.ndim if a < 0 else a for a in tupleize(axis))
+        inshape(f.shape, axes)
+    if len(varargs) == 0:
+        spacing = [1.0] * len(axes)
+    elif len(varargs) == 1 and np.ndim(varargs[0]) == 0:
+        spacing = [float(varargs[0])] * len(axes)
+    elif len(varargs) == len(axes) and all(
+            np.ndim(v) == 0 for v in varargs):
+        spacing = [float(v) for v in varargs]
+    else:
+        raise _Fallback("array spacing")   # coordinate arrays: host path
+    for a in axes:
+        if f.shape[a] < 2:
+            raise ValueError(
+                "Shape of array too small to calculate a numerical "
+                "gradient, at least 2 elements are required.")
+    if len(axes) > 1 and f.deferred:
+        # one program per axis below: materialise a deferred chain ONCE
+        # so N gradients don't re-run it N times
+        f._data
+    outs = [
+        _device_fused("gradient", [f], f, f.split,
+                      lambda d, _a=a, _h=h: jnp.gradient(d, _h, axis=_a),
+                      (a, float(h)))
+        for a, h in zip(axes, spacing)]
+    return outs[0] if len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------
 # dispatcher
 # ---------------------------------------------------------------------
 
