@@ -1439,6 +1439,164 @@ def _gradient(f, *varargs, axis=None, edge_order=1):
 
 
 # ---------------------------------------------------------------------
+# triangles, diagonals, products, selection (round 4, batch 4)
+# ---------------------------------------------------------------------
+
+def _tri_fn(name):
+    import jax.numpy as jnp
+    jfn = getattr(jnp, name)
+
+    def handler(m, k=0):
+        _require_tpu(m)
+        if m.ndim < 2:
+            raise _Fallback("1-d %s" % name)   # numpy promotes to 2-d
+        kk = operator.index(k)
+        return _device_fused(name, [m], m, m.split,
+                             lambda d: jfn(d, k=kk), (kk,))
+    return handler
+
+
+_TABLE[np.tril] = _tri_fn("tril")
+_TABLE[np.triu] = _tri_fn("triu")
+
+
+@_implements(np.diag)
+def _diag(v, k=0):
+    _require_tpu(v)
+    import jax.numpy as jnp
+    kk = operator.index(k)
+    if v.ndim == 2:
+        return v.diagonal(kk)
+    if v.ndim != 1:
+        raise ValueError("Input must be 1- or 2-d.")
+    # building the (n+|k|, n+|k|) matrix: the input axis becomes the
+    # row block, so a key axis stays a key axis
+    return _device_fused("diag", [v], v, v.split,
+                         lambda d: jnp.diag(d, k=kk), (kk,))
+
+
+@_implements(np.diagflat)
+def _diagflat(v, k=0):
+    _require_tpu(v)
+    import jax.numpy as jnp
+    kk = operator.index(k)
+    return _device_fused("diagflat", [v], v, 1 if v.split else 0,
+                         lambda d: jnp.diagflat(d, k=kk), (kk,))
+
+
+@_implements(np.vander)
+def _vander(x, N=None, increasing=False):
+    _require_tpu(x)
+    if x.ndim != 1:
+        raise ValueError("x must be a one-dimensional array or sequence.")
+    import jax.numpy as jnp
+    n = None if N is None else operator.index(N)
+    return _device_fused(
+        "vander", [x], x, x.split,
+        lambda d: jnp.vander(d, N=n, increasing=bool(increasing)),
+        (n, bool(increasing)))
+
+
+@_implements(np.kron)
+def _kron(a, b):
+    anchor = _contraction_anchor(a, b)
+    import jax.numpy as jnp
+    new_split = anchor.split if (anchor is a
+                                 and np.ndim(b) <= np.ndim(a)) else 0
+    return _device_fused("kron", [a, b], anchor, new_split,
+                         lambda x, y: jnp.kron(x, y), ())
+
+
+@_implements(np.select)
+def _select(condlist, choicelist, default=0):
+    conds, choices = list(condlist), list(choicelist)
+    if len(conds) != len(choices):
+        raise ValueError(
+            "list of cases must be same length as list of conditions")
+    if len(conds) == 0:
+        raise ValueError("select with an empty condition list is "
+                         "not possible")
+    import jax.numpy as jnp
+    anchor = _contraction_anchor(*(conds + choices))
+    n = len(conds)
+
+    def body(*ops):
+        return jnp.select(list(ops[:n]), list(ops[n:]), default=default)
+
+    out_shape = np.broadcast_shapes(*(np.shape(o)
+                                      for o in conds + choices))
+    s = anchor.split
+    new_split = s if tuple(out_shape[:s]) == tuple(anchor.shape[:s]) \
+        and len(out_shape) == anchor.ndim else 0
+    if not np.isscalar(default):
+        raise _Fallback("array default")
+    # 0 / 0.0 / False compare-and-hash equal but change the promoted
+    # output dtype — the cache key must carry the type too
+    return _device_fused("select", conds + choices, anchor, new_split,
+                         body, (n, default, type(default).__name__))
+
+
+@_implements(np.compress)
+def _compress(condition, a, axis=None, out=None):
+    _require_default(out=(out, None))
+    if _is_tpu(condition):
+        raise _Fallback("device condition")  # dynamic shape: host path
+    _require_tpu(a)
+    cond = np.asarray(condition)
+    if cond.ndim != 1:
+        raise ValueError("condition must be a 1-d array")
+    dim = a.size if axis is None else a.shape[
+        axis + a.ndim if axis < 0 else axis]
+    idx = np.nonzero(cond)[0]
+    # numpy allows an OVER-long condition when its extra entries are all
+    # False; only a True index past the axis is out of bounds
+    if idx.size and idx[-1] >= dim:
+        raise IndexError(
+            "index %d is out of bounds for axis %d with size %d"
+            % (idx[-1], 0 if axis is None else axis, dim))
+    return a.take(idx, axis=axis)
+
+
+@_implements(np.extract)
+def _extract(condition, arr):
+    if _is_tpu(condition):
+        raise _Fallback("device condition")
+    _require_tpu(arr)
+    idx = np.nonzero(np.asarray(condition).ravel())[0]
+    return arr.take(idx)
+
+
+def _conv1d(name):
+    import jax.numpy as jnp
+    jfn = getattr(jnp, name)
+
+    def handler(a, v, mode="full" if name == "convolve" else "valid"):
+        anchor = _contraction_anchor(a, v)
+        # numpy promotes 0-d operands to 1-d
+        if np.ndim(a) == 0 or np.ndim(v) == 0:
+            if (_is_tpu(a) and np.ndim(a) == 0) or \
+                    (_is_tpu(v) and np.ndim(v) == 0):
+                raise _Fallback("0-d device operand")
+            a = np.atleast_1d(a) if np.ndim(a) == 0 else a
+            v = np.atleast_1d(v) if np.ndim(v) == 0 else v
+        if np.ndim(a) != 1 or np.ndim(v) != 1:
+            raise ValueError("object too deep for desired array")
+        if np.shape(a)[0] == 0 or np.shape(v)[0] == 0:
+            raise ValueError("v cannot be empty")
+        if mode not in ("full", "same", "valid"):
+            raise ValueError(
+                "mode must be one of 'full', 'same', or 'valid'")
+        new_split = min(anchor.split, 1) if anchor is a else 0
+        return _device_fused(name, [a, v], anchor, new_split,
+                             lambda x, y: jfn(x, y, mode=mode), (mode,))
+    return handler
+
+
+_TABLE[np.convolve] = _conv1d("convolve")
+_TABLE[np.correlate] = _conv1d("correlate")
+
+
+# ---------------------------------------------------------------------
 # np.linalg decompositions (round 4, batch 3): jnp.linalg on the global
 # sharded array in ONE fused program — XLA batches the leading (key)
 # axes, so keys survive as batch dims; the (n, n)/(m, n) matrix core is
@@ -1462,16 +1620,31 @@ def _float_body(fn):
     return body
 
 
-def _square_check(a, name):
-    if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
+def _square_check(a):
+    if a.ndim < 2:
+        raise np.linalg.LinAlgError(
+            "%d-dimensional array given. Array must be at least "
+            "two-dimensional" % a.ndim)
+    if a.shape[-1] != a.shape[-2]:
         raise np.linalg.LinAlgError(
             "Last 2 dimensions of the array must be square")
+
+
+def _linalg_result(name, outs):
+    """numpy ≥1.25 returns namedtuples (``EighResult`` etc., attribute
+    access included); mirror that when the (private but stable) types
+    are importable, else a plain tuple."""
+    try:
+        from numpy.linalg import _linalg
+        return getattr(_linalg, name)(*outs)
+    except (ImportError, AttributeError):
+        return tuple(outs)
 
 
 @_implements(np.linalg.inv)
 def _linalg_inv(a):
     _require_tpu(a)
-    _square_check(a, "inv")
+    _square_check(a)
     import jax.numpy as jnp
     return _device_fused("linalg_inv", [a], a, _mat_split(a),
                          _float_body(jnp.linalg.inv), ())
@@ -1500,7 +1673,7 @@ def _linalg_pinv(a, rcond=None, hermitian=False, *, rtol=_NV):
 @_implements(np.linalg.det)
 def _linalg_det(a):
     _require_tpu(a)
-    _square_check(a, "det")
+    _square_check(a)
     import jax.numpy as jnp
     return _device_fused("linalg_det", [a], a, _mat_split(a),
                          _float_body(jnp.linalg.det), ())
@@ -1509,18 +1682,18 @@ def _linalg_det(a):
 @_implements(np.linalg.slogdet)
 def _linalg_slogdet(a):
     _require_tpu(a)
-    _square_check(a, "slogdet")
+    _square_check(a)
     import jax.numpy as jnp
     s = _mat_split(a)
-    return _device_fused(
+    return _linalg_result("SlogdetResult", _device_fused(
         "linalg_slogdet", [a], a, (s, s),
-        _float_body(lambda d: tuple(jnp.linalg.slogdet(d))), ())
+        _float_body(lambda d: tuple(jnp.linalg.slogdet(d))), ()))
 
 
 @_implements(np.linalg.cholesky)
 def _linalg_cholesky(a, *, upper=False):
     _require_tpu(a)
-    _square_check(a, "cholesky")
+    _square_check(a)
     import jax.numpy as jnp
 
     def chol(d):
@@ -1552,20 +1725,20 @@ def _check_uplo(UPLO):
 @_implements(np.linalg.eigh)
 def _linalg_eigh(a, UPLO="L"):
     _require_tpu(a)
-    _square_check(a, "eigh")
+    _square_check(a)
     _check_uplo(UPLO)
     import jax.numpy as jnp
     s = _mat_split(a)
-    return _device_fused(
+    return _linalg_result("EighResult", _device_fused(
         "linalg_eigh", [a], a, (s, s),
         _float_body(lambda d: tuple(jnp.linalg.eigh(_uplo_sym(d, UPLO)))),
-        (UPLO,))
+        (UPLO,)))
 
 
 @_implements(np.linalg.eigvalsh)
 def _linalg_eigvalsh(a, UPLO="L"):
     _require_tpu(a)
-    _square_check(a, "eigvalsh")
+    _square_check(a)
     _check_uplo(UPLO)
     import jax.numpy as jnp
     # dedicated single-output program: the eigh path would materialise
@@ -1586,12 +1759,12 @@ def _linalg_svd(a, full_matrices=True, compute_uv=True, hermitian=False):
     import jax.numpy as jnp
     s = _mat_split(a)
     if compute_uv:
-        return _device_fused(
+        return _linalg_result("SVDResult", _device_fused(
             "linalg_svd", [a], a, (s, s, s),
             _float_body(lambda d: tuple(jnp.linalg.svd(
                 d, full_matrices=bool(full_matrices),
                 hermitian=bool(hermitian)))),
-            (bool(full_matrices), bool(hermitian)))
+            (bool(full_matrices), bool(hermitian))))
     return _device_fused(
         "linalg_svdvals", [a], a, s,
         _float_body(lambda d: jnp.linalg.svd(
@@ -1620,10 +1793,10 @@ def _linalg_qr(a, mode="reduced"):
         return _device_fused(
             "linalg_qr_r", [a], a, s,
             _float_body(lambda d: jnp.linalg.qr(d, mode="r")), ())
-    return _device_fused(
+    return _linalg_result("QRResult", _device_fused(
         "linalg_qr", [a], a, (s, s),
         _float_body(lambda d: tuple(jnp.linalg.qr(d, mode=mode))),
-        (mode,))
+        (mode,)))
 
 
 @_implements(np.linalg.solve)
@@ -1649,7 +1822,7 @@ def _linalg_solve(a, b):
 @_implements(np.linalg.matrix_power)
 def _linalg_matrix_power(a, n):
     _require_tpu(a)
-    _square_check(a, "matrix_power")
+    _square_check(a)
     n = operator.index(n)
     import jax.numpy as jnp
     return _device_fused(

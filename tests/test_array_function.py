@@ -965,3 +965,115 @@ def test_batch23_review_edges(mesh):
     from bolt_tpu.tpu import array as am
     np.linalg.eigvalsh(bs)
     assert any(k[0] == "linalg_eigvalsh" for k in am._JIT_CACHE)
+
+
+# ----------------------------------------------------------------------
+# round 4 batch 4: triangles, diagonals, products, selection
+# ----------------------------------------------------------------------
+
+TAIL4_CASES = [
+    ("tril", lambda a: np.tril(a[:, :, 0])),
+    ("tril-k", lambda a: np.tril(a[:, :, 0], -1)),
+    ("triu-k", lambda a: np.triu(a[:, :, 0], 2)),
+    ("diag-2d", lambda a: np.diag(a[:, :, 0], 1)),
+    ("diag-1d", lambda a: np.diag(a[:, 0, 0])),
+    ("diagflat", lambda a: np.diagflat(a[:, :2, 0])),
+    ("vander", lambda a: np.vander(a[:, 0, 0], 4)),
+    ("kron", lambda a: np.kron(a, np.ones((1, 2, 2)))),
+    ("select", lambda a: np.select([a > 0.5, a < -0.5], [a, -a],
+                                   default=7.0)),
+    ("compress", lambda a: np.compress(
+        np.array([True, False] * 4), a, axis=0)),
+    ("extract", lambda a: np.extract(np.asarray(a) > 0, a)),
+    ("convolve", lambda a: np.convolve(a[:, 0, 0],
+                                       np.array([0.5, 1.0, 0.5]))),
+    ("correlate-full", lambda a: np.correlate(
+        a[:, 0, 0], np.array([0.5, 1.0, 0.5]), "full")),
+]
+
+
+@pytest.mark.parametrize("layout", ["keys1d", "keys2d"])
+@pytest.mark.parametrize("name,call", TAIL4_CASES,
+                         ids=[c[0] for c in TAIL4_CASES])
+def test_dispatch_tail4_parity(request, layout, name, call):
+    if layout == "keys1d":
+        m, axis = request.getfixturevalue("mesh"), (0,)
+    else:
+        m, axis = request.getfixturevalue("mesh2d"), (0, 1)
+    x = _x2()[:8]
+    b = bolt.array(x, m, axis=axis)
+    if layout == "keys2d" and name in ("diag-1d", "vander", "convolve",
+                                       "correlate-full"):
+        pytest.skip("1-d slice of a 2-d-keys array has a single key "
+                    "axis")
+    expect = call(x)
+    got = call(b)
+    g = np.asarray(got.toarray() if hasattr(got, "toarray") else got)
+    e = np.asarray(expect)
+    assert g.shape == e.shape, (name, g.shape, e.shape)
+    assert np.allclose(g, e, equal_nan=True), name
+
+
+def test_dispatch_tail4_details(mesh):
+    x = _x2()[:8]
+    b = bolt.array(x, mesh)
+    # compress/extract are static host-condition paths; a device
+    # condition (dynamic shape) falls back but stays correct
+    cond = np.asarray(x[:, 0, 0]) > 0
+    out = np.compress(cond, b, axis=0)
+    assert out.mode == "tpu"
+    assert np.allclose(out.toarray(), np.compress(cond, x, axis=0))
+    dev_cond = (b[:, 0, 0] > 0)
+    out2 = np.extract(dev_cond, b)
+    assert np.allclose(np.asarray(out2), np.extract(cond, x))
+    # numpy-exact rejections
+    with pytest.raises(ValueError, match="same length"):
+        np.select([b > 0], [b, b])
+    with pytest.raises(ValueError, match="one-dimensional"):
+        np.vander(b)
+    with pytest.raises(ValueError, match="1- or 2-d"):
+        np.diag(b)
+    with pytest.raises(ValueError, match="mode"):
+        np.convolve(b[:, 0, 0], np.ones(3), mode="bogus")
+    # split bookkeeping: triangles/diag keep keys, 2-d diag reduces
+    assert np.tril(b[:, :, 0]).split == 1
+    assert np.diag(b[:, 0, 0]).split == 1
+    assert np.diag(b[:, :, 0]).split == 0   # diagonal of keys x values
+
+
+def test_batch4_review_edges(mesh):
+    x = _x2()[:8]
+    b = bolt.array(x, mesh)
+    # over-long compress condition with trailing False entries is legal
+    cond = np.array([True, False] * 4 + [False, False])
+    assert np.allclose(np.compress(cond, b, axis=0).toarray(),
+                       np.compress(cond, x, axis=0))
+    with pytest.raises(IndexError, match="out of bounds"):
+        np.compress(np.array([False] * 9 + [True]), b, axis=0)
+    # select's default dtype participates in promotion; 0 vs 0.0 must
+    # not collide in the executable cache
+    iv = bolt.array(np.arange(8), mesh)
+    o_int = np.select([iv > 3], [iv], default=0)
+    o_flt = np.select([iv > 3], [iv], default=0.0)
+    assert np.asarray(o_int.toarray()).dtype.kind == "i"
+    assert np.asarray(o_flt.toarray()).dtype.kind == "f"
+    # scalar convolve operands promote like numpy
+    v = bolt.array(np.arange(6.0), mesh)
+    assert np.allclose(np.asarray(np.convolve(v, 2.0).toarray()),
+                       np.convolve(np.arange(6.0), 2.0))
+    # multi-output linalg results carry numpy's attribute API
+    sq = _spd()
+    bs = bolt.array(sq, mesh)
+    r = np.linalg.slogdet(bs)
+    assert np.allclose(np.asarray(r.sign.toarray()),
+                       np.linalg.slogdet(sq).sign)
+    e = np.linalg.eigh(bs)
+    assert np.allclose(np.asarray(e.eigenvalues.toarray()),
+                       np.linalg.eigh(sq).eigenvalues)
+    s = np.linalg.svd(bolt.array(_tall(), mesh))
+    assert hasattr(s, "S") and hasattr(s, "Vh")
+    q = np.linalg.qr(bolt.array(_tall(), mesh))
+    assert hasattr(q, "Q") and hasattr(q, "R")
+    # 1-d inputs get numpy's at-least-two-dimensional message
+    with pytest.raises(np.linalg.LinAlgError, match="two-dimensional"):
+        np.linalg.inv(bolt.array(np.arange(4.0), mesh))
