@@ -981,6 +981,50 @@ def _append(arr, values, axis=None):
 # contractions (MXU path — same "highest" precision policy as `dot`)
 # ---------------------------------------------------------------------
 
+def _expand_einsum_ellipsis(subs, shapes):
+    """Rewrite ``...`` into explicit (upper-case, unused) labels with
+    numpy's semantics: per-operand ellipsis dims align RIGHT against
+    the widest, and in implicit mode the broadcast labels lead the
+    output.  Returns an explicit ``in->out`` string."""
+    ins, arrow, out = subs.partition("->")
+    terms = ins.split(",")
+    if len(terms) != len(shapes):
+        raise _Fallback("operand count mismatch")
+    widths = []
+    for t, sh in zip(terms, shapes):
+        if "..." in t:
+            if t.count("...") > 1:
+                raise _Fallback("multiple ellipses in one term")
+            k = len(sh) - (len(t) - 3)
+            if k < 0:
+                raise _Fallback("ellipsis width")   # host raises exactly
+            widths.append(k)
+        else:
+            widths.append(0)
+    bmax = max(widths) if widths else 0
+    used = set(subs)
+    pool = [c for c in "ABCDEFGHIJKLMNOPQRSTUVWXYZ" if c not in used]
+    if len(pool) < bmax:
+        raise _Fallback("too many broadcast dims")
+    ell = "".join(pool[:bmax])
+    new_terms = [t.replace("...", ell[bmax - w:]) if "..." in t else t
+                 for t, w in zip(terms, widths)]
+    if arrow and "..." not in out and bmax > 0:
+        # numpy: an explicit output (even an EMPTY one) must carry
+        # '...' when broadcast dims exist — the host path raises its
+        # exact error
+        raise _Fallback("output missing ellipsis")
+    if out:
+        new_out = out.replace("...", ell)
+    elif arrow:
+        new_out = ell                       # explicit empty output
+    else:
+        from collections import Counter
+        cnt = Counter(c for t in new_terms for c in t if c not in ell)
+        new_out = ell + "".join(sorted(c for c in cnt if cnt[c] == 1))
+    return ",".join(new_terms) + "->" + new_out
+
+
 def _contraction_anchor(*ops):
     anchor = None
     for o in ops:
@@ -1007,7 +1051,7 @@ def _einsum(*operands, out=None, optimize=False, **kwargs):
     subs = operands[0].replace(" ", "")
     ops = list(operands[1:])
     if "..." in subs:
-        raise _Fallback("ellipsis")
+        subs = _expand_einsum_ellipsis(subs, [np.shape(o) for o in ops])
     anchor = _contraction_anchor(*ops)
     ins = subs.split("->")[0]
     terms = ins.split(",")
@@ -1436,6 +1480,103 @@ def _gradient(f, *varargs, axis=None, edge_order=1):
                       (a, float(h)))
         for a, h in zip(axes, spacing)]
     return outs[0] if len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------
+# np.fft (round 4): jnp.fft on the global sharded array, one program
+# per call; key axes survive positionally (a transform along a sharded
+# axis gathers that axis inside XLA, like any cross-shard op)
+# ---------------------------------------------------------------------
+
+def _fft1(name):
+    def handler(a, n=None, axis=-1, norm=None, out=None):
+        _require_default(out=(out, None))
+        _require_tpu(a)
+        import jax.numpy as jnp
+        jfn = getattr(jnp.fft, name)
+        nn = None if n is None else operator.index(n)
+        ax = operator.index(axis)
+        return _device_fused(
+            "fft_" + name, [a], a, a.split,
+            lambda d: jfn(d, n=nn, axis=ax, norm=norm), (nn, ax, norm))
+    return handler
+
+
+def _fftn(name):
+    def handler(a, s=None, axes=None, norm=None, out=None):
+        _require_default(out=(out, None))
+        _require_tpu(a)
+        import jax.numpy as jnp
+        from bolt_tpu.utils import tupleize
+        jfn = getattr(jnp.fft, name)
+        st = None if s is None else tuple(operator.index(v)
+                                          for v in tupleize(s))
+        axt = None if axes is None else tuple(operator.index(v)
+                                              for v in tupleize(axes))
+        if axt is None and name.endswith("2"):
+            axt = (-2, -1)      # jnp's 2-d forms reject axes=None
+        return _device_fused(
+            "fft_" + name, [a], a, a.split,
+            lambda d: jfn(d, s=st, axes=axt, norm=norm),
+            (st, axt, norm))
+    return handler
+
+
+for _name in ("fft", "ifft", "rfft", "irfft", "hfft", "ihfft"):
+    _TABLE[getattr(np.fft, _name)] = _fft1(_name)
+for _name in ("fft2", "ifft2", "fftn", "ifftn", "rfft2", "irfft2",
+              "rfftn", "irfftn"):
+    _TABLE[getattr(np.fft, _name)] = _fftn(_name)
+
+
+def _fftshift_fn(name):
+    def handler(x, axes=None):
+        _require_tpu(x)
+        import jax.numpy as jnp
+        from bolt_tpu.utils import tupleize
+        jfn = getattr(jnp.fft, name)
+        axt = None if axes is None else tuple(operator.index(v)
+                                              for v in tupleize(axes))
+        return _device_fused("fft_" + name, [x], x, x.split,
+                             lambda d: jfn(d, axes=axt), (axt,))
+    return handler
+
+
+_TABLE[np.fft.fftshift] = _fftshift_fn("fftshift")
+_TABLE[np.fft.ifftshift] = _fftshift_fn("ifftshift")
+
+
+@_implements(np.apply_along_axis)
+def _apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    _require_tpu(arr)
+    import jax
+    import jax.numpy as jnp
+    from bolt_tpu.tpu.array import _TRACE_ERRORS, _traceable
+    ax = operator.index(axis)
+    ax = ax + arr.ndim if ax < 0 else ax
+    if not 0 <= ax < arr.ndim:
+        raise np.exceptions.AxisError(axis, arr.ndim)
+    try:
+        hash((args, tuple(sorted(kwargs.items()))))
+        hashable = all(not hasattr(v, "__array__")
+                       for v in list(args) + list(kwargs.values()))
+    except TypeError:
+        hashable = False
+    if not hashable:
+        raise _Fallback("unhashable func1d extras")
+    f = _traceable(func1d)
+    try:
+        jax.eval_shape(lambda v: f(v, *args, **kwargs),
+                       jax.ShapeDtypeStruct((arr.shape[ax],), arr.dtype))
+    except _TRACE_ERRORS:
+        raise _Fallback("non-traceable func1d")   # host path, warned
+    # keys before the applied axis survive; the func1d output dims land
+    # AT the axis position, displacing everything after it
+    new_split = arr.split if ax >= arr.split else ax
+    return _device_fused(
+        "apply_along_axis", [arr], arr, new_split,
+        lambda d: jnp.apply_along_axis(f, ax, d, *args, **kwargs),
+        (f, ax, args, tuple(sorted(kwargs.items()))))
 
 
 # ---------------------------------------------------------------------

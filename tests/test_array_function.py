@@ -586,9 +586,9 @@ def test_dispatch_tail_fallbacks_stay_correct(mesh):
     # numpy-correct
     x = _x2()
     b = bolt.array(x, mesh)
-    out = np.einsum("i...,i...->...", b, b)          # ellipsis: host
-    assert isinstance(out, np.ndarray)
-    assert np.allclose(out, np.einsum("i...,i...->...", x, x))
+    out = np.einsum("i...,i...->...", b, b)     # ellipsis: device (r4)
+    assert hasattr(out, "mode") and out.mode == "tpu"
+    assert np.allclose(out.toarray(), np.einsum("i...,i...->...", x, x))
     out2 = np.pad(b, 1, mode="mean")                 # stat mode: host
     assert np.allclose(out2, np.pad(x, 1, mode="mean"))
     out3 = np.pad(b, 1, mode="linear_ramp", end_values=2.0)
@@ -1077,3 +1077,107 @@ def test_batch4_review_edges(mesh):
     # 1-d inputs get numpy's at-least-two-dimensional message
     with pytest.raises(np.linalg.LinAlgError, match="two-dimensional"):
         np.linalg.inv(bolt.array(np.arange(4.0), mesh))
+
+
+# ----------------------------------------------------------------------
+# round 4 batch 5: np.fft, apply_along_axis, einsum ellipsis
+# ----------------------------------------------------------------------
+
+FFT_CASES = [
+    ("fft", lambda a: np.fft.fft(a)),
+    ("fft-n-axis", lambda a: np.fft.fft(a, n=10, axis=1)),
+    ("ifft", lambda a: np.fft.ifft(a, axis=0)),
+    ("rfft", lambda a: np.fft.rfft(a)),
+    ("irfft-roundtrip", lambda a: np.fft.irfft(np.fft.rfft(a), n=4)),
+    ("hfft", lambda a: np.fft.hfft(a)),
+    ("ihfft", lambda a: np.fft.ihfft(a)),
+    ("fft2", lambda a: np.fft.fft2(a)),
+    ("ifft2", lambda a: np.fft.ifft2(a)),
+    ("rfft2", lambda a: np.fft.rfft2(a)),
+    ("fftn-axes", lambda a: np.fft.fftn(a, axes=(0, 2))),
+    ("fftn-s", lambda a: np.fft.fftn(a, s=(6, 3), axes=(1, 2))),
+    ("rfftn", lambda a: np.fft.rfftn(a)),
+    ("irfftn-roundtrip", lambda a: np.fft.irfftn(np.fft.rfftn(a),
+                                                 s=np.shape(a))),
+    ("fft-ortho", lambda a: np.fft.fft(a, norm="ortho")),
+    ("fft-forward", lambda a: np.fft.fft(a, norm="forward")),
+    ("fftshift", lambda a: np.fft.fftshift(a)),
+    ("fftshift-axis", lambda a: np.fft.fftshift(a, axes=1)),
+    ("ifftshift", lambda a: np.fft.ifftshift(a, axes=(0, 2))),
+    ("apply-scalar", lambda a: np.apply_along_axis(
+        lambda v: v.sum(), 1, a)),
+    ("apply-vector", lambda a: np.apply_along_axis(
+        lambda v: v[:2] * 2.0, 2, a)),
+    ("apply-matrix", lambda a: np.apply_along_axis(
+        lambda v: np.outer(v[:2], v[:2]), 0, a)),
+    ("einsum-ellipsis", lambda a: np.einsum("i...,i...->...", a, a)),
+    ("einsum-ellipsis-keep", lambda a: np.einsum("...j->...", a)),
+    ("einsum-ellipsis-implicit", lambda a: np.einsum("...ij", a)),
+    ("einsum-ellipsis-mixed", lambda a: np.einsum(
+        "...i,ij->...j", a, np.ones((4, 2)))),
+]
+
+
+@pytest.mark.parametrize("layout", ["keys1d", "keys2d"])
+@pytest.mark.parametrize("name,call", FFT_CASES,
+                         ids=[c[0] for c in FFT_CASES])
+def test_dispatch_tail5_parity(request, layout, name, call):
+    if layout == "keys1d":
+        m, axis = request.getfixturevalue("mesh"), (0,)
+    else:
+        m, axis = request.getfixturevalue("mesh2d"), (0, 1)
+    x = _x2()[:8]
+    b = bolt.array(x, m, axis=axis)
+    expect = call(x)
+    got = call(b)
+    g = np.asarray(got.toarray() if hasattr(got, "toarray") else got)
+    e = np.asarray(expect)
+    assert g.shape == e.shape, (name, g.shape, e.shape)
+    assert np.allclose(g, e, equal_nan=True), name
+
+
+def test_dispatch_tail5_details(mesh):
+    x = _x2()[:8]
+    b = bolt.array(x, mesh)
+    # fft along a value axis keeps the keys; apply_along_axis keeps the
+    # keys ahead of the applied axis
+    assert np.fft.fft(b, axis=2).split == 1
+    assert np.apply_along_axis(lambda v: v.sum(), 2, b).split == 1
+    assert np.apply_along_axis(lambda v: v.sum(), 0, b).split == 0
+    # device results really are device-resident
+    assert np.fft.fft(b).mode == "tpu"
+    # non-traceable func1d takes the warned host fallback, same answer
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = np.apply_along_axis(
+            lambda v: float(np.asarray(v).sum()), 1, b)
+    assert np.allclose(out, np.apply_along_axis(lambda v: v.sum(), 1, x))
+    # numpy's explicit-output-needs-ellipsis rule holds (host raises)
+    with pytest.raises(ValueError, match="ellipsis"):
+        np.einsum("i...,...->i", b, bolt.array(x[0], mesh))
+    # einsum ellipsis key survival: broadcast dims lead the output, so
+    # keys survive only when the anchor's keys are the leading
+    # broadcast/batch labels
+    assert np.einsum("i...,i...->...", b, b).split == 0
+    assert np.einsum("...k,kj->...j", b, np.ones((4, 3))).split == 1
+
+
+def test_batch5_review_edges(mesh):
+    x = _x2()[:8]
+    b = bolt.array(x, mesh)
+    # unhashable kwargs VALUES fall back instead of crashing the cache
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = np.apply_along_axis(
+            lambda v, w=None: v * w[0], 1, b, w=[2.0, 3.0])
+    assert np.allclose(np.asarray(out),
+                       np.apply_along_axis(
+                           lambda v, w=None: v * w[0], 1, x,
+                           w=[2.0, 3.0]))
+    # explicit EMPTY einsum output still requires '...' when broadcast
+    # dims exist — numpy's exact error, not a wrong-shaped result
+    b2 = bolt.array(x[:, :, 0], mesh)
+    with pytest.raises(ValueError, match="ellipsis"):
+        np.einsum("i...->", b2)

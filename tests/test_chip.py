@@ -199,6 +199,20 @@ def test_linalg_ops(cmesh):
     _close(sr, expect, rtol=1e-4, atol=1e-4)
 
 
+def test_fft_on_chip(cmesh):
+    # device-side complex compute; this environment's tunnel cannot
+    # TRANSFER complex buffers (raw-jax limitation, STATUS.md), so the
+    # gate fetches real/imag views and real-valued roundtrips
+    x = _x((8, 4, 128), seed=13)
+    b = bolt.array(x, cmesh)
+    g = np.fft.rfft(b)
+    e = np.fft.rfft(x)
+    _close(g.real, e.real.astype(np.float32), rtol=1e-3, atol=1e-3)
+    _close(g.imag, e.imag.astype(np.float32), rtol=1e-3, atol=1e-3)
+    back = np.fft.irfft(np.fft.rfft(b), n=128)
+    _close(back, x, rtol=1e-4, atol=1e-4)
+
+
 def test_dtype_policy_x64_off(cmesh):
     # production numerics: float64 requests canonicalise to f32 silently
     b = bolt.array(np.random.RandomState(12).randn(8, 4), cmesh)
