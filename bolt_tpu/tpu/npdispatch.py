@@ -1483,6 +1483,113 @@ def _gradient(f, *varargs, axis=None, edge_order=1):
 
 
 # ---------------------------------------------------------------------
+# set operations (round 4): the big operands reduce to their (small)
+# device-side uniques — ops.unique's shard-local machinery — and the
+# tiny set algebra runs on host, exactly numpy
+# ---------------------------------------------------------------------
+
+def _uniq_small(x):
+    if _is_tpu(x):
+        from bolt_tpu.ops import unique as bolt_unique
+        return bolt_unique(x)
+    return np.unique(np.asarray(x))
+
+
+@_implements(np.intersect1d)
+def _intersect1d(ar1, ar2, assume_unique=False, return_indices=False):
+    if return_indices:
+        # original positions are lost after the unique reduction
+        raise _Fallback("return_indices")
+    return np.intersect1d(_uniq_small(ar1), _uniq_small(ar2),
+                          assume_unique=True)
+
+
+@_implements(np.union1d)
+def _union1d(ar1, ar2):
+    return np.union1d(_uniq_small(ar1), _uniq_small(ar2))
+
+
+@_implements(np.setdiff1d)
+def _setdiff1d(ar1, ar2, assume_unique=False):
+    return np.setdiff1d(_uniq_small(ar1), _uniq_small(ar2),
+                        assume_unique=True)
+
+
+@_implements(np.setxor1d)
+def _setxor1d(ar1, ar2, assume_unique=False):
+    return np.setxor1d(_uniq_small(ar1), _uniq_small(ar2),
+                       assume_unique=True)
+
+
+# ---------------------------------------------------------------------
+# complex views and cleanup helpers (round 4)
+# ---------------------------------------------------------------------
+
+@_implements(np.angle)
+def _angle(z, deg=False):
+    _require_tpu(z)
+    import jax.numpy as jnp
+    return _device_fused("angle", [z], z, z.split,
+                         lambda d: jnp.angle(d, deg=bool(deg)),
+                         (bool(deg),))
+
+
+@_implements(np.unwrap)
+def _unwrap(p, discont=None, axis=-1, *, period=6.283185307179586):
+    _require_tpu(p)
+    import jax.numpy as jnp
+    ax = operator.index(axis)
+    dc = None if discont is None else float(discont)
+    per = float(period)
+    return _device_fused(
+        "unwrap", [p], p, p.split,
+        lambda d: jnp.unwrap(d, discont=dc, axis=ax, period=per),
+        (dc, ax, per))
+
+
+@_implements(np.sinc)
+def _sinc(x):
+    _require_tpu(x)
+    import jax.numpy as jnp
+    return _device_fused("sinc", [x], x, x.split, jnp.sinc, ())
+
+
+@_implements(np.i0)
+def _i0(x):
+    _require_tpu(x)
+    import jax.numpy as jnp
+    return _device_fused("i0", [x], x, x.split, jnp.i0, ())
+
+
+@_implements(np.nan_to_num)
+def _nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    if not copy:
+        raise _Fallback("copy=False")   # in-place: host path decides
+    _require_tpu(x)
+    import jax.numpy as jnp
+    args = (float(nan), None if posinf is None else float(posinf),
+            None if neginf is None else float(neginf))
+    return _device_fused(
+        "nan_to_num", [x], x, x.split,
+        lambda d: jnp.nan_to_num(d, nan=args[0], posinf=args[1],
+                                 neginf=args[2]), args)
+
+
+def _inf_sign(name):
+    def handler(x, out=None):
+        _require_default(out=(out, None))
+        _require_tpu(x)
+        import jax.numpy as jnp
+        jfn = getattr(jnp, name)
+        return _device_fused(name, [x], x, x.split, jfn, ())
+    return handler
+
+
+_TABLE[np.isposinf] = _inf_sign("isposinf")
+_TABLE[np.isneginf] = _inf_sign("isneginf")
+
+
+# ---------------------------------------------------------------------
 # np.fft (round 4): jnp.fft on the global sharded array, one program
 # per call; key axes survive positionally (a transform along a sharded
 # axis gathers that axis inside XLA, like any cross-shard op)

@@ -1181,3 +1181,60 @@ def test_batch5_review_edges(mesh):
     b2 = bolt.array(x[:, :, 0], mesh)
     with pytest.raises(ValueError, match="ellipsis"):
         np.einsum("i...->", b2)
+
+
+# ----------------------------------------------------------------------
+# round 4 batch 6: set operations, complex views, cleanup helpers
+# ----------------------------------------------------------------------
+
+def test_set_operations_parity(mesh):
+    rs = np.random.RandomState(55)
+    a = rs.randint(0, 20, 64).astype(float)
+    c = rs.randint(10, 30, 48).astype(float)
+    ba, bc = bolt.array(a, mesh), bolt.array(c, mesh)
+    assert np.array_equal(np.intersect1d(ba, bc), np.intersect1d(a, c))
+    assert np.array_equal(np.intersect1d(ba, c), np.intersect1d(a, c))
+    assert np.array_equal(np.union1d(ba, bc), np.union1d(a, c))
+    assert np.array_equal(np.setdiff1d(ba, bc), np.setdiff1d(a, c))
+    assert np.array_equal(np.setxor1d(ba, bc), np.setxor1d(a, c))
+    # return_indices: warned host fallback, numpy-exact triple
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r = np.intersect1d(ba, bc, return_indices=True)
+    e = np.intersect1d(a, c, return_indices=True)
+    assert all(np.array_equal(np.asarray(i), j) for i, j in zip(r, e))
+
+
+def test_complex_and_cleanup_parity(mesh, mesh2d):
+    x = _x2()[:8]
+    for m, axis in ((mesh, (0,)), (mesh2d, (0, 1))):
+        b = bolt.array(x, m, axis=axis)
+        assert np.allclose(np.asarray(np.sinc(b).toarray()), np.sinc(x))
+        assert np.allclose(np.asarray(np.i0(b).toarray()), np.i0(x))
+        p = np.cumsum(np.abs(x), axis=2)
+        bp = bolt.array(p, m, axis=axis)
+        assert np.allclose(np.asarray(np.unwrap(bp).toarray()),
+                           np.unwrap(p))
+        assert np.allclose(
+            np.asarray(np.unwrap(bp, period=3.0, axis=1).toarray()),
+            np.unwrap(p, period=3.0, axis=1))
+        y = x.copy()
+        y[0, 0, 0], y[1, 1, 1], y[2, 2, 2] = np.nan, np.inf, -np.inf
+        by = bolt.array(y, m, axis=axis)
+        assert np.allclose(np.asarray(np.nan_to_num(by).toarray()),
+                           np.nan_to_num(y))
+        assert np.allclose(
+            np.asarray(np.nan_to_num(by, nan=-1, posinf=9).toarray()),
+            np.nan_to_num(y, nan=-1, posinf=9))
+        assert np.array_equal(np.asarray(np.isposinf(by).toarray()),
+                              np.isposinf(y))
+        assert np.array_equal(np.asarray(np.isneginf(by).toarray()),
+                              np.isneginf(y))
+        z = x[:, :, 0] + 1j * x[:, :, 1]
+        bz = bolt.array(z, m, axis=axis)
+        assert np.allclose(np.asarray(np.angle(bz).toarray()),
+                           np.angle(z))
+        assert np.allclose(np.asarray(np.angle(bz, deg=True).toarray()),
+                           np.angle(z, deg=True))
+        assert np.angle(bz).split == b.split
