@@ -476,6 +476,83 @@ def _histogram(a, bins=10, range=None, density=False, weights=None):
     return bolt_histogram(a, bins=bins, range=range, density=density)
 
 
+def _static_bins(bins, d):
+    """Per-dimension static int bin counts, or None → host fallback
+    (array edges are data-dependent shapes)."""
+    if isinstance(bins, (int, np.integer)):
+        return (int(bins),) * d
+    try:
+        seq = list(bins)
+    except TypeError:
+        return None
+    if len(seq) != d or not all(isinstance(v, (int, np.integer))
+                                for v in seq):
+        return None
+    return tuple(int(v) for v in seq)
+
+
+def _static_ranges(range):
+    """Normalized hashable per-dim (lo, hi) ranges; a per-dimension
+    ``None`` entry (numpy-legal: use the data extrema) takes the host
+    fallback rather than crashing the normalization."""
+    if range is None:
+        return None
+    out = []
+    for r in range:
+        if r is None:
+            raise _Fallback("per-dimension None range")
+        out.append(tuple(float(v) for v in r))
+    return tuple(out)
+
+
+@_implements(np.histogram2d)
+def _histogram2d(x, y, bins=10, range=None, density=None, weights=None):
+    _require_default(weights=(weights, None))
+    bb = _static_bins(bins, 2)
+    if bb is None:
+        raise _Fallback("bin edges")
+    anchor = _contraction_anchor(x, y)
+    import jax.numpy as jnp
+    rng_key = _static_ranges(range)
+
+    def body(xx, yy):
+        return tuple(jnp.histogram2d(xx.reshape(-1), yy.reshape(-1),
+                                     bins=list(bb), range=rng_key,
+                                     density=density))
+
+    h, ex, ey = _device_fused("histogram2d", [x, y], anchor, (0, 0, 0),
+                              body, (bb, rng_key, bool(density)))
+    # numpy returns float64 in BOTH branches (float counts / densities)
+    return (np.asarray(h.toarray()).astype(np.float64),
+            np.asarray(ex.toarray()), np.asarray(ey.toarray()))
+
+
+@_implements(np.histogramdd)
+def _histogramdd(sample, bins=10, range=None, density=None,
+                 weights=None):
+    _require_default(weights=(weights, None))
+    _require_tpu(sample)
+    if sample.ndim != 2:
+        raise _Fallback("non-(N, D) sample")   # sequence-of-arrays form
+    d = sample.shape[1]
+    bb = _static_bins(bins, d)
+    if bb is None:
+        raise _Fallback("bin edges")
+    import jax.numpy as jnp
+    rng_key = _static_ranges(range)
+
+    def body(s):
+        h, edges = jnp.histogramdd(s, bins=list(bb), range=rng_key,
+                                   density=density)
+        return (h,) + tuple(edges)
+
+    outs = _device_fused("histogramdd", [sample], sample,
+                         (0,) * (1 + d), body,
+                         (bb, rng_key, bool(density)))
+    return (np.asarray(outs[0].toarray()).astype(np.float64),
+            [np.asarray(e.toarray()) for e in outs[1:]])
+
+
 @_implements(np.bincount)
 def _bincount(a, weights=None, minlength=0):
     _require_default(weights=(weights, None))

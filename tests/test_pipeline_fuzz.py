@@ -237,12 +237,33 @@ def _op_np_stack_self(draw, b, x):
     return np.stack([b, b], axis=ax), np.stack([x, x], axis=ax)
 
 
+def _op_np_fftshift(draw, b, x):
+    # round-4 batch 5: shape-preserving device fftshift
+    ax = draw(st.integers(0, x.ndim - 1))
+    return np.fft.fftshift(b, axes=ax), np.fft.fftshift(x, axes=ax)
+
+
+def _op_np_nanmean(draw, b, x):
+    # round-4 batch 2: nan-aware reduction over a drawn value axis
+    # (key-axis reductions would end the chain's parallelism early)
+    if x.ndim < 2:
+        return b, x
+    ax = draw(st.integers(1, x.ndim - 1))
+    return np.nanmean(b, axis=ax), np.nanmean(x, axis=ax)
+
+
+def _op_np_expand(draw, b, x):
+    ax = draw(st.integers(0, x.ndim))
+    return np.expand_dims(b, ax), np.expand_dims(x, ax)
+
+
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
         _op_concat_self, _op_keys_reshape, _op_smooth, _op_normalize,
         _op_clip, _op_ufunc, _op_matmul, _op_set, _op_with_keys,
         _op_np_sort, _op_take0, _op_np_roll, _op_np_pad,
-        _op_np_stack_self]
+        _op_np_stack_self, _op_np_fftshift, _op_np_nanmean,
+        _op_np_expand]
 
 
 # ----------------------------------------------------------------------
