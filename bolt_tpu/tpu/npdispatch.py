@@ -1560,6 +1560,150 @@ def _gradient(f, *varargs, axis=None, edge_order=1):
 
 
 # ---------------------------------------------------------------------
+# round-4 batch 8: flips, integration, nan-aware cumulatives/arg stats
+# ---------------------------------------------------------------------
+
+@_implements(np.flipud)
+def _flipud(m):
+    if m.ndim < 1:
+        raise ValueError("Input must be >= 1-d.")
+    return _flip(m, 0)
+
+
+@_implements(np.fliplr)
+def _fliplr(m):
+    if m.ndim < 2:
+        raise ValueError("Input must be >= 2-d.")
+    return _flip(m, 1)
+
+
+def _trapezoid(y, x=None, dx=1.0, axis=-1):
+    _require_tpu(y)
+    import jax.numpy as jnp
+    ax = operator.index(axis)
+    if x is None:
+        return _device_fused(
+            "trapezoid", [y], y,
+            _axis_reduced_split(y, (ax + y.ndim if ax < 0 else ax,),
+                                False),
+            lambda d: jnp.trapezoid(d, dx=float(dx), axis=ax),
+            (float(dx), ax))
+    if _is_tpu(x):
+        raise _Fallback("device sample points")
+    xa = np.asarray(x)
+    return _device_fused(
+        "trapezoid_x", [y, xa], y,
+        _axis_reduced_split(y, (ax + y.ndim if ax < 0 else ax,), False),
+        lambda d, xx: jnp.trapezoid(d, xx, axis=ax), (ax, xa.shape))
+
+
+# numpy <2.0 has only trapz, >=2.0 both (trapz deprecated): guard EACH
+if hasattr(np, "trapezoid"):
+    _TABLE[np.trapezoid] = _trapezoid
+if hasattr(np, "trapz"):
+    _TABLE[np.trapz] = _trapezoid
+
+
+@_implements(np.cross)
+def _cross(a, b, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    anchor = _contraction_anchor(a, b)
+    import jax
+    import jax.numpy as jnp
+    if axis is not None or (axisa, axisb, axisc) != (-1, -1, -1):
+        # moved vector axes reshuffle the output layout out from under
+        # the leading-keys bookkeeping: host path
+        raise _Fallback("non-default cross axes")
+    try:
+        out_aval = jax.eval_shape(lambda u, v: jnp.cross(u, v),
+                                  _aval_of(a), _aval_of(b))
+    except Exception:
+        raise _Fallback("cross form")   # e.g. numpy's deprecated 2x3 mix
+    s = anchor.split
+    new_split = s if tuple(out_aval.shape[:s]) == \
+        tuple(anchor.shape[:s]) else 0
+    return _device_fused("cross", [a, b], anchor, new_split,
+                         lambda x, y: jnp.cross(x, y), ())
+
+
+@_implements(np.ediff1d)
+def _ediff1d(ary, to_end=None, to_begin=None):
+    _require_tpu(ary)
+    import jax.numpy as jnp
+    ops = [ary]
+    if to_begin is not None:
+        if _is_tpu(to_begin):
+            raise _Fallback("device to_begin")
+        ops.append(np.asarray(to_begin))
+    if to_end is not None:
+        if _is_tpu(to_end):
+            raise _Fallback("device to_end")
+        ops.append(np.asarray(to_end))
+
+    def body(d, *extras):
+        it = iter(extras)
+        tb = next(it) if to_begin is not None else None
+        te = next(it) if to_end is not None else None
+        return jnp.ediff1d(d, to_end=te, to_begin=tb)
+
+    return _device_fused("ediff1d", ops, ary, min(ary.split, 1), body,
+                         (to_begin is not None, to_end is not None))
+
+
+def _nan_cum(name):
+    def handler(a, axis=None, dtype=None, out=None):
+        _require_default(out=(out, None), dtype=(dtype, None))
+        _require_tpu(a)
+        import jax.numpy as jnp
+        jfn = getattr(jnp, name)
+        ax = None if axis is None else operator.index(axis)
+        # axis=None flattens: the flat result gets the filter-style
+        # flat key axis, matching cumsum's convention
+        new_split = (1 if a.split else 0) if ax is None else a.split
+        return _device_fused(name, [a], a, new_split,
+                             lambda d: jfn(d, axis=ax), (ax,))
+    return handler
+
+
+_TABLE[np.nancumsum] = _nan_cum("nancumsum")
+_TABLE[np.nancumprod] = _nan_cum("nancumprod")
+
+
+def _nan_arg(name):
+    # documented divergence (API.md): an ALL-NaN slice returns jnp's -1
+    # sentinel where numpy raises ValueError — detecting it would force
+    # a device sync on every call
+    def handler(a, axis=None, out=None, *, keepdims=_NV):
+        _require_default(out=(out, None))
+        _require_tpu(a)
+        import jax.numpy as jnp
+        jfn = getattr(jnp, name)
+        kd = _keepdims(keepdims)
+        if axis is None:
+            ax_t = tuple(range(a.ndim))
+        else:
+            ax_t = (operator.index(axis) + a.ndim
+                    if operator.index(axis) < 0 else operator.index(axis),)
+        new_split = _axis_reduced_split(a, ax_t, kd)
+        ax = None if axis is None else operator.index(axis)
+        return _device_fused(name, [a], a, new_split,
+                             lambda d: jfn(d, axis=ax, keepdims=kd),
+                             (ax, kd))
+    return handler
+
+
+_TABLE[np.nanargmax] = _nan_arg("nanargmax")
+_TABLE[np.nanargmin] = _nan_arg("nanargmin")
+
+
+@_implements(np.fix)
+def _fix(x, out=None):
+    _require_default(out=(out, None))
+    _require_tpu(x)
+    import jax.numpy as jnp
+    return _device_fused("fix", [x], x, x.split, jnp.fix, ())
+
+
+# ---------------------------------------------------------------------
 # set operations (round 4): the big operands reduce to their (small)
 # device-side uniques — ops.unique's shard-local machinery — and the
 # tiny set algebra runs on host, exactly numpy

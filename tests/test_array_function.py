@@ -1278,3 +1278,87 @@ def test_histogram2d_dd_parity(mesh):
     hfbn = np.histogram2d(x, y, bins=[np.linspace(-2, 2, 5),
                                       np.linspace(-2, 2, 4)])[0]
     assert np.allclose(hfb, hfbn)
+
+
+# ----------------------------------------------------------------------
+# round 4 batch 8: flips, integration, nan-aware cumulatives/arg stats
+# ----------------------------------------------------------------------
+
+TAIL8_CASES = [
+    ("flipud", lambda a: np.flipud(a)),
+    ("fliplr", lambda a: np.fliplr(a)),
+    ("trapezoid", lambda a: np.trapezoid(a)),
+    ("trapezoid-dx-axis", lambda a: np.trapezoid(a, dx=0.5, axis=1)),
+    ("trapezoid-x", lambda a: np.trapezoid(a, np.linspace(0, 1, 4),
+                                           axis=2)),
+    ("ediff1d", lambda a: np.ediff1d(a)),
+    ("ediff1d-ends", lambda a: np.ediff1d(a, to_end=[9.0],
+                                          to_begin=[-1.0, -2.0])),
+    ("nancumsum-flat", lambda a: np.nancumsum(a)),
+    ("nancumsum-axis", lambda a: np.nancumsum(a, axis=1)),
+    ("nancumprod-axis", lambda a: np.nancumprod(a, axis=2)),
+    ("nanargmax-flat", lambda a: np.nanargmax(a)),
+    ("nanargmax-axis", lambda a: np.nanargmax(a, axis=1)),
+    ("nanargmin-axis", lambda a: np.nanargmin(a, axis=0)),
+    ("fix", lambda a: np.fix(a * 3)),
+]
+
+
+@pytest.mark.parametrize("name,call", TAIL8_CASES,
+                         ids=[c[0] for c in TAIL8_CASES])
+def test_dispatch_tail8_parity(mesh, name, call):
+    x = _xnan() if "nan" in name else _x2()[:8]
+    if name in ("ediff1d", "ediff1d-ends"):
+        x = x[:, 0, 0].copy()
+    b = bolt.array(x, mesh)
+    expect = call(x)
+    got = call(b)
+    g = np.asarray(got.toarray() if hasattr(got, "toarray") else got)
+    e = np.asarray(expect)
+    assert g.shape == e.shape, (name, g.shape, e.shape)
+    assert np.allclose(g, e, equal_nan=True), name
+
+
+def test_cross_parity(mesh):
+    v3 = np.random.RandomState(57).randn(16, 3)
+    b3 = bolt.array(v3, mesh)
+    w = np.array([1.0, 0.5, 0.25])
+    assert np.allclose(np.asarray(np.cross(b3, w).toarray()),
+                       np.cross(v3, w))
+    assert np.cross(b3, w).split == 1
+    other = np.random.RandomState(58).randn(16, 3)
+    assert np.allclose(np.asarray(np.cross(b3, other).toarray()),
+                       np.cross(v3, other))
+    # 2-vector cross products (scalar result per pair)
+    v2 = v3[:, :2]
+    b2 = bolt.array(v2, mesh)
+    assert np.allclose(np.asarray(np.cross(b2, v2[::-1]).toarray()),
+                       np.cross(v2, v2[::-1]))
+
+
+def test_tail8_split_bookkeeping(mesh):
+    x = _xnan()
+    b = bolt.array(x, mesh)
+    assert np.nancumsum(b, axis=2).split == 1
+    assert np.nancumsum(b).split == 1            # flat key convention
+    assert np.nanargmax(b, axis=1).split == 1
+    assert np.nanargmax(b, axis=0).split == 0
+    assert np.trapezoid(b, axis=2).split == 1
+    assert np.flipud(b).split == 1
+
+
+def test_batch8_review_edges(mesh):
+    v3 = np.random.RandomState(59).randn(16, 3)
+    b3 = bolt.array(v3, mesh)
+    # non-default cross axes fall back, numpy-correct
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = np.cross(b3, v3[::-1], axisc=0)
+    assert np.allclose(out, np.cross(v3, v3[::-1], axisc=0))
+    # mixed 2x3 vectors: numpy's deprecated-but-working path
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mixed = np.cross(bolt.array(v3[:, :2], mesh), np.ones(3))
+        expect = np.cross(v3[:, :2], np.ones(3))
+    assert np.allclose(np.asarray(mixed), expect)
