@@ -146,6 +146,15 @@ def _op_ufunc(draw, b, x):
     return uf(b), uf(x)
 
 
+def _op_ufunc_method(draw, b, x):
+    # round-5 ufunc METHOD surface (VERDICT r4 missing-3): the
+    # shape-preserving accumulate must lower to one fused device program
+    # on TPU and hit ndarray's native machinery locally — identical
+    # spelling on both.  add keeps magnitudes bounded (×axis-length)
+    ax = draw(st.integers(0, x.ndim - 1))
+    return np.add.accumulate(b, axis=ax), np.add.accumulate(x, axis=ax)
+
+
 def _op_matmul(draw, b, x):
     # @ over the last value axis (round 2): shape-preserving
     # well-conditioned weight, batched over every leading axis
@@ -263,7 +272,7 @@ _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_clip, _op_ufunc, _op_matmul, _op_set, _op_with_keys,
         _op_np_sort, _op_take0, _op_np_roll, _op_np_pad,
         _op_np_stack_self, _op_np_fftshift, _op_np_nanmean,
-        _op_np_expand]
+        _op_np_expand, _op_ufunc_method]
 
 
 # ----------------------------------------------------------------------
@@ -349,7 +358,7 @@ def _lop_normalize(draw, b, x):
 _LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _op_clip, _lop_filter,
               _lop_chunked_map, _lop_stacked_map, _lop_smooth,
               _lop_concat_self, _lop_normalize, _op_ufunc, _lop_matmul,
-              _op_set, _op_np_sort, _op_take0]
+              _op_set, _op_np_sort, _op_take0, _op_ufunc_method]
 
 
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
