@@ -1359,11 +1359,23 @@ class BoltArrayTPU(BoltArray):
             odata = jnp.asarray(other.toarray())
         else:
             odata = self._coerce_operand(other)
-        if np.broadcast_shapes(self.shape, odata.shape) != self.shape:
-            raise ValueError(
-                "operand of shape %s does not broadcast into %s"
-                % (tuple(odata.shape), self.shape))
+        # numpy broadcasting is symmetric: the result may OUTGROW self
+        # (np.ones(8) * b_scalar).  Keys survive while they remain the
+        # leading axes with unchanged lengths; a result that gains
+        # leading dims is replicated.  (The shape-mismatch ValueError
+        # for incompatible operands comes from broadcast_shapes itself.)
+        out_shape = np.broadcast_shapes(self.shape, odata.shape)
         mesh, split = self._mesh, self._split
+        if out_shape != self.shape:
+            if len(out_shape) != self.ndim or \
+                    out_shape[:split] != self.shape[:split]:
+                split = 0
+            out_item = np.dtype(_canon(np.result_type(
+                self.dtype, odata.dtype))).itemsize
+            need = int(np.prod(out_shape)) * out_item \
+                + self.size * self.dtype.itemsize \
+                + int(odata.size) * odata.dtype.itemsize
+            hbm_check(opname, need, "both inputs + broadcast output")
 
         def build():
             def run(a, b):

@@ -199,10 +199,11 @@ def test_unsupported_kwargs_fall_back_correctly(mesh):
     assert np.allclose(out, x.sum())
     # dtype= falls back and matches numpy exactly
     assert np.allclose(np.sum(b, dtype=np.float32), x.sum(dtype=np.float32))
-    # unhandled function (np.partition) → host path, numpy result
-    st = np.partition(b, 2, axis=0)
+    # unhandled function (np.trim_zeros) → host path, numpy result
+    v = bolt.array(np.array([0.0, 0.0, 1.0, 2.0, 0.0]), mesh)
+    st = np.trim_zeros(v)
     assert isinstance(st, np.ndarray)
-    assert np.allclose(st, np.partition(x, 2, axis=0))
+    assert np.allclose(st, [1.0, 2.0])
 
 
 def test_implicit_gather_warns_once_above_threshold(mesh, monkeypatch):
@@ -1362,3 +1363,198 @@ def test_batch8_review_edges(mesh):
         mixed = np.cross(bolt.array(v3[:, :2], mesh), np.ones(3))
         expect = np.cross(v3[:, :2], np.ones(3))
     assert np.allclose(np.asarray(mixed), expect)
+
+
+# ----------------------------------------------------------------------
+# round-5 dispatch tail (VERDICT r4 missing-4): take_along_axis,
+# lexsort, meshgrid/block/broadcast_arrays, insert/delete/resize, the
+# last np.linalg utilities, fft frequency grids, nonsymmetric-eig
+# policy — device-served with numpy semantics, both mesh layouts
+# ----------------------------------------------------------------------
+
+TAIL9_CASES = [
+    ("take_along_axis", lambda a: np.take_along_axis(
+        a, np.argsort(np.asarray(a), axis=2), axis=2)),
+    ("take_along_axis-key", lambda a: np.take_along_axis(
+        a, np.zeros((1, 6, 4), dtype=int), axis=0)),
+    ("take_along_axis-neg", lambda a: np.take_along_axis(
+        a, np.full((8, 6, 1), -1), axis=2)),
+    ("take_along_axis-flat", lambda a: np.take_along_axis(
+        a, np.array([0, 17, 5]), axis=None)),
+    ("lexsort-seq", lambda a: np.lexsort(
+        (np.round(a[:, 0, 0]), np.round(a[:, 1, 0])))),
+    ("meshgrid-ij", lambda a: np.meshgrid(
+        a[:, 0, 0], np.arange(3.0), indexing="ij")[0]),
+    ("meshgrid-xy", lambda a: np.meshgrid(
+        a[:, 0, 0], np.arange(3.0), indexing="xy")[1]),
+    ("block-flat", lambda a: np.block([a[:, 0, 0], a[:, 1, 1]])),
+    ("block-2d", lambda a: np.block(
+        [[a[:, :, 0], a[:, :, 1]], [a[:, :, 2], a[:, :, 3]]])),
+    ("broadcast_arrays", lambda a: np.broadcast_arrays(
+        a, np.ones((1, 6, 1)))[1]),
+    ("broadcast_arrays-self", lambda a: np.broadcast_arrays(
+        a, np.ones(4))[0]),
+    ("insert-int", lambda a: np.insert(a, 2, 5.0, axis=1)),
+    ("insert-flat", lambda a: np.insert(a, 3, [1.0, 2.0])),
+    ("insert-arr", lambda a: np.insert(a, [1, 3], 0.0, axis=2)),
+    ("delete-int", lambda a: np.delete(a, 2, axis=1)),
+    ("delete-neg", lambda a: np.delete(a, -1, axis=0)),
+    ("delete-slice", lambda a: np.delete(a, slice(1, 4), axis=1)),
+    ("delete-arr", lambda a: np.delete(a, [0, 2], axis=2)),
+    ("delete-flat", lambda a: np.delete(a, [0, 5, 7])),
+    ("resize-up", lambda a: np.resize(a, (10, 6, 4))),
+    ("resize-reshape", lambda a: np.resize(a, (4, 12, 4))),
+    ("resize-flat", lambda a: np.resize(a, 100)),
+    ("linalg-cond", lambda a: np.linalg.cond(
+        a[:4, :4, 0] + 3 * np.eye(4))),
+    ("linalg-cond-1", lambda a: np.linalg.cond(
+        a[:4, :4, 0] + 3 * np.eye(4), p=1)),
+    ("linalg-multi_dot", lambda a: np.linalg.multi_dot(
+        [a[:, :, 0], np.ones((6, 5)), np.linspace(0, 1, 5)])),
+]
+
+
+@pytest.mark.parametrize("layout", ["keys1d", "keys2d"])
+@pytest.mark.parametrize("name,call", TAIL9_CASES,
+                         ids=[c[0] for c in TAIL9_CASES])
+def test_dispatch_tail9_parity(request, layout, name, call):
+    if layout == "keys1d":
+        m, axis = request.getfixturevalue("mesh"), (0,)
+    else:
+        m, axis = request.getfixturevalue("mesh2d"), (0, 1)
+    x = _x2()
+    b = bolt.array(x, m, axis=axis)
+    expect = call(x)
+    got = call(b)
+
+    def norm(v):
+        return np.asarray(v.toarray() if hasattr(v, "toarray") else v)
+
+    g, e = norm(got), norm(expect)
+    assert g.shape == e.shape, (name, g.shape, e.shape)
+    assert np.allclose(g, e, equal_nan=True), name
+
+
+def test_tail9_partition_invariants(mesh):
+    """partition's within-partition order is unspecified, so parity is
+    the INVARIANT (kth element in sorted place, partitions as sets),
+    not array equality."""
+    x = _x2()
+    b = bolt.array(x, mesh)
+    for kth in (0, 3, -1):
+        got = np.asarray(np.partition(b, kth, axis=2).toarray())
+        k = kth + 4 if kth < 0 else kth
+        srt = np.sort(x, axis=2)
+        assert np.allclose(got[..., k], srt[..., k])
+        assert np.allclose(np.sort(got, axis=2), srt)
+        assert (got[..., :k] <= got[..., k:k + 1]).all()
+        assert (got[..., k + 1:] >= got[..., k:k + 1]).all()
+    # flat + key-axis forms
+    gf = np.asarray(np.partition(b, 10, axis=None).toarray())
+    assert np.allclose(np.sort(gf), np.sort(x, axis=None))
+    assert (gf[:10] <= gf[10]).all()
+    g0 = np.asarray(np.partition(b, 2, axis=0).toarray())
+    assert np.allclose(g0[2], np.sort(x, axis=0)[2])
+    # argpartition: indices select the same invariant values
+    ai = np.asarray(np.argpartition(b, 3, axis=2).toarray())
+    vals = np.take_along_axis(x, ai, axis=2)
+    assert np.allclose(vals[..., 3], np.sort(x, axis=2)[..., 3])
+    # kth validation matches numpy on both backends
+    lo = bolt.array(x)
+    for t in (lo, b):
+        with pytest.raises(ValueError, match="out of bounds"):
+            np.partition(t, 99, axis=2)
+
+
+def test_tail9_linalg_details(mesh):
+    rs = np.random.RandomState(47)
+    A = rs.randn(6, 4, 6, 4) + 5 * np.eye(24).reshape(6, 4, 6, 4)
+    bA = bolt.array(A, mesh, axis=(0,))
+    got = np.linalg.tensorinv(bA, ind=2)
+    assert np.allclose(np.asarray(got.toarray()),
+                       np.linalg.tensorinv(A, ind=2), atol=1e-8)
+    bvec = rs.randn(6, 4)
+    gs = np.linalg.tensorsolve(bA, bolt.array(bvec, mesh))
+    assert np.allclose(np.asarray(gs.toarray()),
+                       np.linalg.tensorsolve(A, bvec), atol=1e-8)
+    with pytest.raises(ValueError, match="Invalid ind"):
+        np.linalg.tensorinv(bA, ind=0)
+    # nonsymmetric eig: explicit documented policy, not a silent gather
+    sq = bolt.array(rs.randn(4, 4), mesh)
+    with pytest.raises(NotImplementedError, match="nonsymmetric"):
+        np.linalg.eig(sq)
+    with pytest.raises(NotImplementedError, match="nonsymmetric"):
+        np.linalg.eigvals(sq)
+    with pytest.raises(np.linalg.LinAlgError):
+        np.linalg.cond(bolt.array(rs.randn(5), mesh))
+
+
+def test_tail9_fftfreq(mesh):
+    # a 0-d device scalar arises from a full reduction
+    d = bolt.array(np.full(4, 0.25), mesh).mean()
+    assert d.ndim == 0
+    got = np.fft.fftfreq(8, d)
+    assert np.allclose(np.asarray(got.toarray()), np.fft.fftfreq(8, 0.25))
+    got = np.fft.rfftfreq(9, d)
+    assert np.allclose(np.asarray(got.toarray()), np.fft.rfftfreq(9, 0.25))
+
+
+def test_tail9_put_along_axis_policy(mesh):
+    b = bolt.array(_x2(), mesh)
+    # the host fallback would mutate a discarded copy — loud reject
+    with pytest.raises(TypeError, match="immutable"):
+        np.put_along_axis(b, np.zeros((8, 6, 1), dtype=int), 0.0, axis=2)
+    # numpy target + device indices still works through the host path
+    host = _x2()
+    idx = bolt.array(np.zeros((8, 6, 1)).astype(int), mesh)
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        np.put_along_axis(host, idx, 7.0, axis=2)
+    assert (host[:, :, 0] == 7.0).all()
+
+
+def test_tail9_validation_parity(mesh):
+    x = _x2()
+    lo, tp = bolt.array(x), bolt.array(x, mesh)
+    for b in (lo, tp):
+        with pytest.raises(ValueError):
+            np.take_along_axis(b, np.zeros((8, 6), dtype=int), axis=2)
+        with pytest.raises(IndexError):
+            np.take_along_axis(b, np.full((8, 6, 1), 9), axis=2)
+        with pytest.raises(IndexError):
+            np.delete(b, 99, axis=0)
+        with pytest.raises(IndexError):
+            np.insert(b, 99, 0.0, axis=0)
+        with pytest.raises(IndexError):
+            np.insert(b, [99], 0.0, axis=0)   # array selector too
+        with pytest.raises(ValueError):
+            np.meshgrid(b[:, 0, 0], np.arange(3.0), indexing="bogus")
+    # lexsort ties: stable on both backends
+    k1 = np.array([3, 1, 3, 1, 2, 2, 0, 0], dtype=float)
+    k2 = np.array([1, 1, 0, 0, 1, 1, 0, 0], dtype=float)
+    got = np.lexsort((bolt.array(k1, mesh), bolt.array(k2, mesh)))
+    assert np.array_equal(np.asarray(got.toarray()), np.lexsort((k1, k2)))
+    # single 2-d key array: rows are the key sequence, last row primary
+    karr = np.stack([k1, k2])
+    g2 = np.lexsort(bolt.array(karr, mesh))
+    assert np.array_equal(np.asarray(g2.toarray()), np.lexsort(karr))
+
+
+def test_tail9_split_bookkeeping(mesh):
+    x = _x2()
+    b = bolt.array(x, mesh)
+    assert np.take_along_axis(
+        b, np.argsort(np.asarray(x), axis=2), axis=2).split == 1
+    assert np.partition(b, 2, axis=2).split == 1
+    assert np.delete(b, 1, axis=1).split == 1
+    assert np.insert(b, 1, 0.0, axis=1).split == 1
+    assert np.resize(b, (10, 6, 4)).split == 1
+    assert np.linalg.multi_dot([b[:, :, 0], np.ones((6, 2))]).split == 1
+    # a 1-d first operand is contracted away: no fabricated key axis
+    assert np.linalg.multi_dot(
+        [b[:, 0, 0], np.ones((8, 6)), np.ones((6, 2))]).split == 0
+    outs = np.broadcast_arrays(b, np.ones(4))
+    assert isinstance(outs, tuple) and outs[0].split == 1
+    grids = np.meshgrid(b[:, 0, 0], np.arange(3.0))
+    assert isinstance(grids, list)

@@ -266,13 +266,36 @@ def _op_np_expand(draw, b, x):
     return np.expand_dims(b, ax), np.expand_dims(x, ax)
 
 
+def _op_np_delete(draw, b, x):
+    # round-5 tail: static-selector delete along a drawn axis exercises
+    # shape bookkeeping through the chain on both backends
+    ax = draw(st.integers(0, x.ndim - 1))
+    if x.shape[ax] < 2:
+        return b, x
+    i = draw(st.integers(0, x.shape[ax] - 1))
+    return np.delete(b, i, axis=ax), np.delete(x, i, axis=ax)
+
+
+def _op_np_take_along(draw, b, x):
+    # round-5 tail: take_along_axis with a flip permutation (shape-
+    # preserving, deterministic) along a drawn axis
+    ax = draw(st.integers(0, x.ndim - 1))
+    n = x.shape[ax]
+    shp = [1] * x.ndim
+    shp[ax] = n
+    idx = np.arange(n - 1, -1, -1).reshape(shp)
+    idx = np.broadcast_to(idx, x.shape)
+    return (np.take_along_axis(b, idx, axis=ax),
+            np.take_along_axis(x, idx, axis=ax))
+
+
 _OPS = [_op_map_affine, _op_operator, _op_slice0, _op_swap, _op_vtranspose,
         _op_astype, _op_filter, _op_chunked_map, _op_stacked_map,
         _op_concat_self, _op_keys_reshape, _op_smooth, _op_normalize,
         _op_clip, _op_ufunc, _op_matmul, _op_set, _op_with_keys,
         _op_np_sort, _op_take0, _op_np_roll, _op_np_pad,
         _op_np_stack_self, _op_np_fftshift, _op_np_nanmean,
-        _op_np_expand, _op_ufunc_method]
+        _op_np_expand, _op_ufunc_method, _op_np_delete, _op_np_take_along]
 
 
 # ----------------------------------------------------------------------
@@ -358,7 +381,8 @@ def _lop_normalize(draw, b, x):
 _LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _op_clip, _lop_filter,
               _lop_chunked_map, _lop_stacked_map, _lop_smooth,
               _lop_concat_self, _lop_normalize, _op_ufunc, _lop_matmul,
-              _op_set, _op_np_sort, _op_take0, _op_ufunc_method]
+              _op_set, _op_np_sort, _op_take0, _op_ufunc_method,
+              _op_np_delete, _op_np_take_along]
 
 
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))

@@ -46,7 +46,31 @@ def test_array_operand(mesh):
     row = np.random.RandomState(14).randn(5)
     assert allclose((b + row).toarray(), x + row)
     with pytest.raises(ValueError):
-        b + np.ones((9, 1, 1))  # does not broadcast into (8, 4, 5)
+        b + np.ones((9, 1, 1))  # incompatible shapes still reject
+
+
+def test_array_operand_broadcast_outgrows_self(mesh):
+    """numpy broadcasting is symmetric: np.ones(8) * b_scalar outgrows
+    the device operand (this is how np.fft.fftfreq(n, d_device) is
+    served compositionally).  Keys survive only while they stay the
+    leading axes with unchanged lengths."""
+    x = _x()
+    b = bolt.array(x, mesh)
+    s = b.mean(axis=(0, 1, 2))         # 0-d device scalar
+    out = np.ones(8) * s
+    assert isinstance(out, type(b)) and out.split == 0
+    assert allclose(out.toarray(), np.ones(8) * x.mean())
+    assert allclose((np.arange(6.0) + s).toarray(),
+                    np.arange(6.0) + x.mean())
+    # value-dim growth keeps the keys
+    col = bolt.array(x[:, :, :1], mesh)
+    grown = col * np.ones(5)
+    assert grown.split == 1 and grown.shape == (8, 4, 5)
+    assert allclose(grown.toarray(), x[:, :, :1] * np.ones(5))
+    # leading-dim growth replicates
+    led = b + np.ones((3, 8, 4, 5))
+    assert led.split == 0
+    assert allclose(led.toarray(), x + np.ones((3, 8, 4, 5)))
 
 
 def test_bolt_operand(mesh):
