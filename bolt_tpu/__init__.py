@@ -20,12 +20,13 @@ from bolt_tpu.factory import (array, concatenate, fromcallback, full, ones,
 from bolt_tpu.base import BoltArray, HostFallbackWarning
 from bolt_tpu.local.array import BoltArrayLocal
 from bolt_tpu.tpu.array import BoltArrayTPU
+from bolt_tpu.precision import precision
 from bolt_tpu.utils import allclose
 
 __all__ = ["array", "ones", "zeros", "full", "rand", "randn",
-           "fromcallback", "concatenate", "allclose", "BoltArray",
-           "BoltArrayLocal", "BoltArrayTPU", "HostFallbackWarning",
-           "__version__"]
+           "fromcallback", "concatenate", "allclose", "precision",
+           "BoltArray", "BoltArrayLocal", "BoltArrayTPU",
+           "HostFallbackWarning", "__version__"]
 
 _SUBMODULES = ("checkpoint", "profile", "parallel", "ops", "statcounter",
                "utils")

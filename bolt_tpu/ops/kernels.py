@@ -311,14 +311,14 @@ def _band_weights(taps, dtype):
     return wt.astype(dtype)
 
 
-def _band_kernel(x_ref, w_ref, o_ref):
+def _band_kernel(x_ref, w_ref, o_ref, *, precision="highest"):
     blk = x_ref[...]                              # (1, S, T, 128)
     zero = jnp.zeros(blk.shape[:-2] + (1, 128), blk.dtype)
     xl = jnp.concatenate([zero, blk[..., :-1, :]], axis=-2)
     xr = jnp.concatenate([blk[..., 1:, :], zero], axis=-2)
     big = jnp.concatenate([xl, blk, xr], axis=-1)  # (1, S, T, 384)
     o_ref[...] = jnp.einsum("bstk,ko->bsto", big, w_ref[...],
-                            precision="highest")
+                            precision=precision)
 
 
 # block budget for the band kernel: S·L·itemsize ≤ 2 MB measured safe
@@ -327,7 +327,7 @@ def _band_kernel(x_ref, w_ref, o_ref):
 _BAND_BLOCK_BYTES = 2 << 20
 
 
-def lane_band_pallas(x, taps, interpret=None):
+def lane_band_pallas(x, taps, interpret=None, precision="highest"):
     """Pallas form of the banded-matmul lane filter: each block reads
     HBM once, builds its 384-channel shifted operand in VMEM, and runs
     ONE MXU matmul — measured 30.5 ms vs the XLA conv form's 40.6 ms on
@@ -350,7 +350,7 @@ def lane_band_pallas(x, taps, interpret=None):
     if interpret is None:
         interpret = _interpret_default()
     out = pl.pallas_call(
-        _band_kernel,
+        partial(_band_kernel, precision=precision),
         grid=(B, s1 // S),
         in_specs=[pl.BlockSpec((1, S, T, 128), lambda i, j: (i, j, 0, 0)),
                   pl.BlockSpec((384, 128), lambda i, j: (0, 0))],
@@ -466,7 +466,8 @@ def _sep1d_kernel(x_ref, o_ref, *, taps, ax, mode):
     o_ref[...] = _filter1d(x_ref[...], ax, taps, mode, jnp)
 
 
-def sepfilter1d(x, taps, ax, mode="constant", interpret=None):
+def sepfilter1d(x, taps, ax, mode="constant", interpret=None,
+                precision="highest"):
     """1-d correlation of ``x`` with ``taps`` along ``ax`` ('same' size,
     boundary per numpy-pad ``mode``) in ONE HBM pass.
 
@@ -487,9 +488,10 @@ def sepfilter1d(x, taps, ax, mode="constant", interpret=None):
             # wide window on the lane axis: banded matmul on the MXU,
             # one read + one write (round 4) — pallas form first, XLA
             # conv form when the block plan doesn't fit
-            out = lane_band_pallas(x, taps, interpret=interpret)
+            out = lane_band_pallas(x, taps, interpret=interpret,
+                                   precision=precision)
             if out is None:
-                out = lane_band_conv(x, taps)
+                out = lane_band_conv(x, taps, precision=precision)
             if out is not None:
                 return out
         if nd >= 2 and x.shape[nd - 2] % 128 == 0:
@@ -499,7 +501,7 @@ def sepfilter1d(x, taps, ax, mode="constant", interpret=None):
             # still beat a 17x shifted-slice re-read
             y = jnp.swapaxes(x, nd - 2, nd - 1)
             out = sepfilter1d(y, taps, nd - 2, mode=mode,
-                              interpret=interpret)
+                              interpret=interpret, precision=precision)
             return None if out is None else jnp.swapaxes(out, nd - 2, nd - 1)
     plan = sepfilter_plan(x.shape, x.dtype.itemsize, ax, len(taps))
     if plan is None:

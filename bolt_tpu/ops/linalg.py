@@ -265,14 +265,15 @@ def _check_k(k, d):
     return k
 
 
-def _gram(x, xp):
+def _gram(x, xp, precision="highest"):
     """The Gram matrix ``X^H X`` of ``(..., n, d)`` data — one MXU matmul
-    on TPU (highest precision, f32 accumulation)."""
+    on TPU ("highest" precision, f32 accumulation, unless the caller
+    resolved a cheaper mode through the scoped policy)."""
     xt = xp.swapaxes(x, -1, -2)
     if xp.iscomplexobj(x):
         xt = xp.conj(xt)
     return xp.matmul(xt, x) if xp is np else \
-        xp.matmul(xt, x, precision="highest",
+        xp.matmul(xt, x, precision=precision,
                   preferred_element_type=_acc_dtype(x.dtype))
 
 
@@ -442,7 +443,7 @@ def tsqr(x):
 
 
 def pca(b, k=None, center=False, axis=None, return_mean=False,
-        fetch=True):
+        fetch=True, precision=None):
     """Distributed PCA of a bolt array: sample axes x feature axes, all
     in ONE compiled SPMD program.
 
@@ -485,7 +486,16 @@ def pca(b, k=None, center=False, axis=None, return_mean=False,
     then syncs nothing — back-to-back pca calls (or downstream jnp use
     of the components) pipeline without paying a host round-trip each,
     which on a remote attach is the dominant per-call cost.
+
+    ``precision=None`` resolves through the scoped policy
+    (``bolt.precision``), pinned at ``"highest"`` — the Gram and
+    projection matmuls are the measured ~2x of this op's cost;
+    ``"default"`` trades ~1e-2 relative score accuracy for it
+    (BASELINE round-4 MFU table).  The local oracle always computes in
+    f64.
     """
+    from bolt_tpu.precision import resolve
+    pr = resolve(precision)
     mode, b, x_full, split, shape, n, d = _samples_features(
         b, axis, "pca", hint="; for plain matrices use tallskinny_pca")
     kshape = shape[:split]
@@ -534,15 +544,16 @@ def pca(b, k=None, center=False, axis=None, return_mean=False,
             # test_pca_centering_fold_large_offset).  Pre-shift data with
             # larger offsets.
             mu = jnp.mean(x, axis=0) if center else jnp.zeros(d, x.dtype)
-            g = _gram(x, jnp)
+            g = _gram(x, jnp, pr)
             if center:
                 g = g - n * jnp.outer(jnp.conj(mu), mu)
             vec, ev = _decompose_gram(g, k, jnp, _tpu_eigh)
-            # precision="highest": the MXU's bf16 default costs ~3 decimal
-            # digits on f32 data — visible in scores at PCA scale
-            scores = jnp.matmul(x, vec, precision="highest")
+            # pinned "highest": the MXU's bf16 default costs ~3 decimal
+            # digits on f32 data — visible in scores at PCA scale; the
+            # scoped policy buys it back where the user accepts that
+            scores = jnp.matmul(x, vec, precision=pr)
             if center:
-                scores = scores - jnp.matmul(mu, vec, precision="highest")
+                scores = scores - jnp.matmul(mu, vec, precision=pr)
             scores = scores.reshape(kshape + (k,))
             scores = jax.lax.with_sharding_constraint(
                 scores, key_sharding(mesh, kshape + (k,), split))
@@ -550,7 +561,7 @@ def pca(b, k=None, center=False, axis=None, return_mean=False,
         return jax.jit(program)
 
     fn = _cached_jit(("ops-pca", funcs, base.shape, str(base.dtype), split,
-                      mesh, k, center), build)
+                      mesh, k, center, pr), build)
     scores, vec, sv, mu = fn(base)
     wrapped = type(b)(scores, split, mesh)
     if not fetch:
@@ -613,7 +624,8 @@ def _samples_features(b, axis, name, hint=""):
     return mode, b, x_full, split, shape, prod(shape[:split]), prod(shape[split:])
 
 
-def cov(b, axis=None, center=True, ddof=1, return_mean=False):
+def cov(b, axis=None, center=True, ddof=1, return_mean=False,
+        precision=None):
     """Feature-covariance matrix of a bolt array viewed as samples ×
     features, in ONE compiled SPMD program.
 
@@ -629,7 +641,11 @@ def cov(b, axis=None, center=True, ddof=1, return_mean=False):
     ~``eps_f32 * (||mu||/sigma)^2`` relative accuracy at large mean
     offsets.  Returns a (d, d) NumPy array;
     ``return_mean=True`` appends the per-feature mean.  Superset of the
-    reference (its ecosystem computes this via per-chunk jobs)."""
+    reference (its ecosystem computes this via per-chunk jobs).
+    ``precision=None`` resolves through the scoped policy like
+    :func:`pca` (the Gram matmul is the cost)."""
+    from bolt_tpu.precision import resolve
+    pr = resolve(precision)
     mode, b, x_full, split, shape, n, d = _samples_features(b, axis, "cov")
     if n - ddof <= 0:
         raise ValueError("cov needs more than ddof=%d samples, got %d"
@@ -660,7 +676,7 @@ def cov(b, axis=None, center=True, ddof=1, return_mean=False):
             # (mu/sigma)^2 relative error in the entries).
             mu = jnp.mean(x, axis=0) if center else jnp.zeros(d, x.dtype)
             c = jnp.matmul(jnp.swapaxes(x, -1, -2), jnp.conj(x),
-                           precision="highest",
+                           precision=pr,
                            preferred_element_type=_acc_dtype(x.dtype))
             if center:
                 c = c - n * jnp.outer(mu, jnp.conj(mu))
@@ -677,7 +693,7 @@ def cov(b, axis=None, center=True, ddof=1, return_mean=False):
         return jax.jit(program)
 
     fn = _cached_jit(("ops-cov", funcs, base.shape, str(base.dtype), split,
-                      mesh, center, ddof), build)
+                      mesh, center, ddof, pr), build)
     c, mu = fn(base)
     if return_mean:
         c, mu = jax.device_get((c, mu))    # one batched round-trip
@@ -685,13 +701,14 @@ def cov(b, axis=None, center=True, ddof=1, return_mean=False):
     return np.asarray(jax.device_get(c))
 
 
-def corrcoef(b, axis=None):
+def corrcoef(b, axis=None, precision=None):
     """Feature-correlation matrix (Pearson) of a bolt array viewed as
     samples × features: :func:`cov` normalised by the outer product of
     the per-feature standard deviations (the (d, d) result is tiny, so
     the normalisation runs on host).  Zero-variance features yield
-    NaN rows/columns, matching ``np.corrcoef``."""
-    c = cov(b, axis=axis, center=True, ddof=1)
+    NaN rows/columns, matching ``np.corrcoef``.  ``precision`` threads
+    to the cov Gram like :func:`pca`'s."""
+    c = cov(b, axis=axis, center=True, ddof=1, precision=precision)
     sd = np.sqrt(np.diag(c))
     with np.errstate(divide="ignore", invalid="ignore"):
         r = c / np.outer(sd, sd)

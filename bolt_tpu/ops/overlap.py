@@ -120,7 +120,8 @@ def _sepfilter_fn(taps_key, axes, mode):
     return sepfilter
 
 
-def _separable_filter(b, taps_list, axes, size, mode, shard=None):
+def _separable_filter(b, taps_list, axes, size, mode, shard=None,
+                      precision=None):
     """Shared core of :func:`smooth`/:func:`convolve`/:func:`gaussian`:
     one program applying a 1-d tap filter per axis.
 
@@ -133,11 +134,13 @@ def _separable_filter(b, taps_list, axes, size, mode, shard=None):
     (unplannable geometry, non-float dtype, a failed compile on this
     toolchain) falls back to the halo-chunked machinery, which also
     serves ``shard=`` (sequence-parallel) and the local oracle."""
+    from bolt_tpu.precision import resolve
+    pr = resolve(precision)
     mode = _canon_mode(mode)
     depth = tuple(len(t) // 2 for t in taps_list)
     taps_key = tuple(tuple(float(t) for t in taps) for taps in taps_list)
     if b.mode == "tpu" and shard is None:
-        out = _whole_array_sepfilter(b, taps_key, tuple(axes), mode)
+        out = _whole_array_sepfilter(b, taps_key, tuple(axes), mode, pr)
         if out is not None:
             return out
     sepfilter = _sepfilter_fn(taps_key, tuple(axes), mode)
@@ -145,7 +148,7 @@ def _separable_filter(b, taps_list, axes, size, mode, shard=None):
                        shard=shard)
 
 
-def _whole_array_sepfilter(b, taps_key, axes, mode):
+def _whole_array_sepfilter(b, taps_key, axes, mode, precision="highest"):
     """ONE compiled program filtering every requested axis of the full
     (sharded) array — Pallas window kernel per axis, shifted-slice for
     any axis the plan can't serve.  Returns None (caller takes the
@@ -173,7 +176,7 @@ def _whole_array_sepfilter(b, taps_key, axes, mode):
     mesh = b.mesh
     base, funcs = b._chain_parts()
     key = ("sepfilter", taps_key, axes, mode, funcs, base.shape,
-           str(base.dtype), split, mesh)
+           str(base.dtype), split, mesh, precision)
     if key in _SEPFILTER_FAILED:
         return None                        # this toolchain said no once
 
@@ -181,7 +184,8 @@ def _whole_array_sepfilter(b, taps_key, axes, mode):
         def run(d):
             x = _chain_apply(funcs, split, d)
             for g, taps in active:
-                y = kernels.sepfilter1d(x, taps, g, mode=mode)
+                y = kernels.sepfilter1d(x, taps, g, mode=mode,
+                                        precision=precision)
                 x = y if y is not None else _filter1d(x, g, taps, mode, jnp)
             return _constrain(x, mesh, split)
         return jax.jit(run)
@@ -217,7 +221,8 @@ def _filter_axes(b, axis):
     return axes
 
 
-def smooth(b, width, axis=None, size="150", mode="constant", shard=None):
+def smooth(b, width, axis=None, size="150", mode="constant", shard=None,
+           precision=None):
     """Separable moving-average (boxcar) filter along value axes — the
     Thunder-style spatial smoothing workload, one halo-padded blockwise
     program per backend.
@@ -236,11 +241,12 @@ def smooth(b, width, axis=None, size="150", mode="constant", shard=None):
     axes = _filter_axes(b, axis)
     widths = _odd_widths(width, len(axes))
     taps_list = [[1.0 / w] * w for w in widths]
-    return _separable_filter(b, taps_list, axes, size, mode, shard=shard)
+    return _separable_filter(b, taps_list, axes, size, mode, shard=shard,
+                             precision=precision)
 
 
 def convolve(b, kernel, axis=None, size="150", mode="constant",
-             shard=None):
+             shard=None, precision=None):
     """Separable correlation with explicit 1-d kernels along value axes.
 
     ``kernel``: a 1-d sequence of odd length, or one such sequence per
@@ -259,11 +265,12 @@ def convolve(b, kernel, axis=None, size="150", mode="constant",
                              % (len(axes), len(axes), len(kern)))
         taps_list = [[float(t) for t in k] for k in kern]
     _odd_widths([len(taps) for taps in taps_list], len(taps_list))
-    return _separable_filter(b, taps_list, axes, size, mode, shard=shard)
+    return _separable_filter(b, taps_list, axes, size, mode, shard=shard,
+                             precision=precision)
 
 
 def gaussian(b, sigma, axis=None, size="150", mode="constant", truncate=4.0,
-             shard=None):
+             shard=None, precision=None):
     """Separable Gaussian filter along value axes (``scipy.ndimage.
     gaussian_filter`` tap construction: radius ``truncate * sigma``,
     normalised).  ``sigma``: scalar or per-``axis``."""
@@ -277,7 +284,8 @@ def gaussian(b, sigma, axis=None, size="150", mode="constant", truncate=4.0,
         grid = np.arange(-radius, radius + 1, dtype=np.float64)
         taps = np.exp(-0.5 * (grid / s) ** 2) if s > 0 else np.ones(1)
         taps_list.append([float(t) for t in taps / taps.sum()])
-    return _separable_filter(b, taps_list, axes, size, mode, shard=shard)
+    return _separable_filter(b, taps_list, axes, size, mode, shard=shard,
+                             precision=precision)
 
 
 def median_filter(b, width, axis=None, size="150", mode="symmetric",

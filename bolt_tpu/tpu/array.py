@@ -1429,14 +1429,17 @@ class BoltArrayTPU(BoltArray):
         return self._elementwise(other, jnp.floor_divide, reverse=True)
 
     def _matmul(self, other, reverse=False, op=jnp.matmul,
-                precision="highest"):
+                precision=None):
         """Contraction with ndarray semantics (``op`` = ``jnp.matmul`` for
         ``@``, ``jnp.dot`` for :meth:`dot`), batched over the key axes:
         ONE compiled program on the full logical array — the MXU-shaped
         path, far better than a per-record map.  The key axes stay
         key-sharded whenever they survive as leading output axes;
         otherwise (contracted or displaced by broadcasting) the result is
-        re-keyed to ``split=0``."""
+        re-keyed to ``split=0``.  ``precision=None`` resolves through the
+        scoped policy (``bolt.precision``), pinned at "highest"."""
+        from bolt_tpu.precision import resolve
+        precision = resolve(precision)
         if isinstance(other, BoltArrayTPU):
             self._check_mesh(other, op.__name__)
             odata = other._data
@@ -1494,7 +1497,7 @@ class BoltArrayTPU(BoltArray):
     def __rmatmul__(self, other):
         return self._matmul(other, reverse=True)
 
-    def dot(self, other, *, precision="highest"):
+    def dot(self, other, *, precision=None):
         """``numpy.dot`` semantics (the ndarray method the local backend
         inherits): matrix product for 2-d, inner product for 1-d, and for
         higher ranks the sum-product over self's LAST axis and ``other``'s
@@ -1502,12 +1505,13 @@ class BoltArrayTPU(BoltArray):
         compiled MXU program.
 
         ``precision`` (keyword-only — ndarray.dot's second POSITIONAL is
-        ``out``, which this backend does not take): ``"highest"``
-        (default — f32 MXU accumulation, ulp-level numpy parity) or any
-        jax precision; ``"default"`` (bf16 passes) measured 2.8x faster
-        on an 8192x8192 product at ~1e-2 relative error.  ``@`` always
-        uses "highest" (operator spelling cannot carry options; numpy
-        parity wins there)."""
+        ``out``, which this backend does not take): ``None`` resolves
+        through the scoped policy (``bolt.precision``), pinned at
+        ``"highest"`` — f32 MXU accumulation, ulp-level numpy parity;
+        ``"default"`` (bf16 passes) measured 2.8x faster on an 8192x8192
+        product at ~1e-2 relative error.  ``@`` follows the SCOPE (the
+        operator spelling cannot carry options) and stays "highest"
+        outside one."""
         return self._matmul(other, op=jnp.dot, precision=precision)
 
     def take(self, indices, axis=None, mode="raise"):

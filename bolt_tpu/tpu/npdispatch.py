@@ -1155,9 +1155,11 @@ def _einsum(*operands, out=None, optimize=False, **kwargs):
         and outl[:split] == term[:split]
         and tuple(out_aval.shape[:split]) == tuple(anchor.shape[:split])
     ) else 0
+    from bolt_tpu.precision import resolve
+    pr = resolve()
     return _device_fused(
         "einsum", ops, anchor, new_split,
-        lambda *ds: jnp.einsum(subs, *ds, precision="highest"), (subs,))
+        lambda *ds: jnp.einsum(subs, *ds, precision=pr), (subs, pr))
 
 
 @_implements(np.tensordot)
@@ -1186,10 +1188,12 @@ def _tensordot(a, b, axes=2):
         if all(x >= a.split for x in pa) and \
                 tuple(out_aval.shape[:a.split]) == tuple(a.shape[:a.split]):
             new_split = a.split
+    from bolt_tpu.precision import resolve
+    pr = resolve()
     return _device_fused(
         "tensordot", [a, b], anchor, new_split,
         lambda x, y: jnp.tensordot(x, y, (ax_a, ax_b),
-                                   precision="highest"), (ax_a, ax_b))
+                                   precision=pr), (ax_a, ax_b, pr))
 
 
 @_implements(np.inner)
@@ -1207,9 +1211,11 @@ def _inner(a, b):
         cap = min(a.split, max(a.ndim - 1, 0))
         if tuple(out_aval.shape[:cap]) == tuple(a.shape[:cap]):
             new_split = cap
+    from bolt_tpu.precision import resolve
+    pr = resolve()
     return _device_fused(
         "inner", [a, b], anchor, new_split,
-        lambda x, y: jnp.inner(x, y, precision="highest"), ())
+        lambda x, y: jnp.inner(x, y, precision=pr), (pr,))
 
 
 @_implements(np.outer)

@@ -1,0 +1,149 @@
+"""The scoped matmul-precision policy (VERDICT r4 weak-3/4): the
+documented ~2x precision trade on pca/halo/matmul-class ops must be
+user-accessible — a ``bolt.precision`` scope plus per-call kwargs —
+with defaults unchanged.
+
+On the CPU verification mesh every jax precision computes in f32/f64,
+so the two policies agree numerically here; the suite pins the POLICY
+semantics (resolution order, nesting, per-executable caching, the full
+op surface accepting the scope) and runs every family under BOTH modes
+against the oracle with the documented tolerances.  The real-chip
+divergence envelope (~1e-2 relative under "default") is pinned by the
+chip gate (tests/test_chip.py)."""
+
+import numpy as np
+import pytest
+
+import bolt_tpu as bolt
+from bolt_tpu.precision import MODES, precision, resolve
+
+
+def test_resolution_order():
+    # pinned default outside any scope
+    assert resolve() == "highest"
+    assert resolve(pinned="default") == "default"
+    # scope overrides the pin
+    with precision("default"):
+        assert resolve() == "default"
+        # nesting: innermost wins
+        with precision("high"):
+            assert resolve() == "high"
+        assert resolve() == "default"
+    assert resolve() == "highest"
+    # explicit kwarg beats the scope
+    with precision("default"):
+        assert resolve("highest") == "highest"
+
+
+def test_invalid_mode_rejected():
+    with pytest.raises(ValueError, match="precision mode"):
+        with precision("bf16"):
+            pass
+    with pytest.raises(ValueError, match="precision mode"):
+        resolve("fast")
+
+
+def test_scope_is_exception_safe():
+    try:
+        with precision("default"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert resolve() == "highest"
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_matmul_family_under_both_policies(mesh, mode):
+    rs = np.random.RandomState(21)
+    x = rs.randn(8, 6)
+    w = rs.randn(6, 4)
+    b = bolt.array(x, mesh)
+    # CPU mesh: all modes compute alike — the suite asserts the policy
+    # SURFACE serves every family; the chip gate owns the numeric gap
+    with precision(mode):
+        assert np.allclose((b @ w).toarray(), x @ w)
+        assert np.allclose(b.dot(w).toarray(), x @ w)
+        assert np.allclose(np.asarray(np.einsum("ij,jk->ik", b, w)
+                                      .toarray()), x @ w)
+        assert np.allclose(np.asarray(np.tensordot(b, w, axes=1)
+                                      .toarray()), x @ w)
+        assert np.allclose(np.asarray(np.inner(b, w.T).toarray()),
+                           np.inner(x, w.T))
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_pca_cov_under_both_policies(mesh, mode):
+    from bolt_tpu.ops import corrcoef, cov, pca
+    rs = np.random.RandomState(22)
+    x = rs.randn(16, 5)
+    b = bolt.array(x, mesh)
+    with precision(mode):
+        scores, comps, sv = pca(b, k=3, center=True)
+        s2, c2, v2 = pca(bolt.array(x), k=3, center=True)
+        # components match up to per-column sign
+        sign = np.sign(np.sum(comps * c2, axis=0))
+        assert np.allclose(comps * sign, c2, atol=1e-5)
+        assert np.allclose(sv, v2, atol=1e-5)
+        assert np.allclose(cov(b), np.cov(x, rowvar=False), atol=1e-6)
+        assert np.allclose(corrcoef(b), np.corrcoef(x, rowvar=False),
+                           atol=1e-6)
+    # per-call kwarg form, outside any scope
+    assert np.allclose(cov(b, precision="default"),
+                       np.cov(x, rowvar=False), atol=1e-6)
+    pca(b, k=2, precision="high")
+
+
+def test_filters_under_both_policies(mesh):
+    from bolt_tpu.ops import gaussian, smooth
+    rs = np.random.RandomState(23)
+    x = rs.randn(8, 16, 256)
+    b = bolt.array(x, mesh)
+    lo = bolt.array(x)
+    for mode in MODES:
+        with precision(mode):
+            g = gaussian(b, 2.0, axis=(0,))
+            e = gaussian(lo, 2.0, axis=(0,))
+            assert np.allclose(np.asarray(g.toarray()),
+                               np.asarray(e.toarray()), atol=1e-6)
+    # per-call kwarg form
+    s = smooth(b, 3, axis=(0,), precision="default")
+    e = smooth(lo, 3, axis=(0,))
+    assert np.allclose(np.asarray(s.toarray()), np.asarray(e.toarray()),
+                       atol=1e-6)
+
+
+def test_executables_cache_per_mode(mesh):
+    """Scoped and unscoped calls must never share a compiled program:
+    the jit-cache key carries the resolved mode."""
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    rs = np.random.RandomState(24)
+    x = rs.randn(8, 6)
+    w = rs.randn(6, 6)
+    b = bolt.array(x, mesh)
+    (b @ w).toarray()
+    n0 = len([k for k in _JIT_CACHE if k and k[0] == "matmul"])
+    with precision("default"):
+        (b @ w).toarray()
+    n1 = len([k for k in _JIT_CACHE if k and k[0] == "matmul"])
+    assert n1 == n0 + 1
+    # repeat under the same scope: cache hit, no new executable
+    with precision("default"):
+        (b @ w).toarray()
+    n2 = len([k for k in _JIT_CACHE if k and k[0] == "matmul"])
+    assert n2 == n1
+
+
+def test_default_unchanged_outside_scope(mesh):
+    """The library default stays pinned "highest" — a no-scope call and
+    an explicit precision("highest") scope produce the SAME cache key."""
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    rs = np.random.RandomState(25)
+    x = rs.randn(8, 5)
+    w = rs.randn(5, 5)
+    b = bolt.array(x, mesh)
+    (b @ w).toarray()
+    n0 = len([k for k in _JIT_CACHE if k and k[0] == "matmul"])
+    with precision("highest"):
+        (b @ w).toarray()
+    assert len([k for k in _JIT_CACHE
+                if k and k[0] == "matmul"]) == n0
