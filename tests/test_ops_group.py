@@ -287,3 +287,80 @@ def test_topk_dtype_and_nan_parity(mesh):
                               np.asarray(tp_i.toarray())), x.dtype
     with pytest.raises(TypeError):
         topk(bolt.array(cases[0], mesh), 2.7)
+
+
+def test_segment_reduce_matmul_path(mesh):
+    """Round-5: the one-hot MXU form (auto-picked for small segment
+    counts) must match the scatter combine and the oracle exactly-
+    enough, with numpy semantics for non-finite records preserved by
+    the runtime fallback."""
+    from bolt_tpu.ops import segment_reduce
+    rs = np.random.RandomState(33)
+    x = rs.randn(32, 6, 4)
+    lab = rs.randint(0, 5, 32)
+    b, lo = bolt.array(x, mesh), bolt.array(x)
+    for op in ("sum", "mean"):
+        gm = np.asarray(segment_reduce(
+            b, lab, num_segments=5, op=op, method="matmul").toarray())
+        gs = np.asarray(segment_reduce(
+            b, lab, num_segments=5, op=op, method="scatter").toarray())
+        e = np.asarray(segment_reduce(
+            lo, lab, num_segments=5, op=op).toarray())
+        assert np.allclose(gm, gs, rtol=1e-6, atol=1e-9)
+        assert np.allclose(gm, e, rtol=1e-6, atol=1e-9)
+    # per-call precision kwarg and the scoped policy both serve
+    gm = segment_reduce(b, lab, num_segments=5, method="matmul",
+                        precision="high")
+    with bolt.precision("default"):
+        gd = segment_reduce(b, lab, num_segments=5, method="matmul")
+    assert np.allclose(np.asarray(gm.toarray()), np.asarray(gd.toarray()),
+                       rtol=1e-5, atol=1e-8)
+
+
+def test_segment_reduce_matmul_nonfinite_fallback(mesh):
+    """0 x NaN would poison whole value columns through the one-hot
+    matmul; the fused isfinite guard must fall back to scatter
+    semantics at runtime — NaN/Inf stay confined to their own
+    segment."""
+    from bolt_tpu.ops import segment_reduce
+    rs = np.random.RandomState(34)
+    x = rs.randn(16, 5)
+    x[3, 2] = np.nan
+    x[7, 1] = np.inf
+    x[9, 1] = -np.inf
+    lab = rs.randint(0, 4, 16)
+    b, lo = bolt.array(x, mesh), bolt.array(x)
+    g = np.asarray(segment_reduce(
+        b, lab, num_segments=4, method="matmul").toarray())
+    e = np.asarray(segment_reduce(lo, lab, num_segments=4).toarray())
+    assert np.array_equal(np.isnan(g), np.isnan(e))
+    assert np.array_equal(np.isposinf(g), np.isposinf(e))
+    assert np.array_equal(np.isneginf(g), np.isneginf(e))
+    fin = np.isfinite(e)
+    assert np.allclose(g[fin], e[fin])
+
+
+def test_segment_reduce_method_validation(mesh):
+    from bolt_tpu.ops import segment_reduce
+    b = bolt.array(np.ones((8, 3), np.int32), mesh)
+    with pytest.raises(ValueError, match="method"):
+        segment_reduce(b, [0] * 8, num_segments=1, method="magic")
+    # int sum cannot ride the (inexact) matmul; int MEAN promotes first
+    with pytest.raises(ValueError, match="matmul"):
+        segment_reduce(b, [0] * 8, num_segments=1, method="matmul")
+    out = segment_reduce(b, [0] * 8, num_segments=1, op="mean",
+                         method="matmul")
+    assert np.allclose(np.asarray(out.toarray()), 1.0)
+    with pytest.raises(ValueError, match="matmul"):
+        segment_reduce(bolt.array(np.ones((4, 2)), mesh), [0] * 4,
+                       num_segments=1, op="max", method="matmul")
+    # the SAME invalid call rejects identically on the local oracle
+    with pytest.raises(ValueError, match="matmul"):
+        segment_reduce(bolt.array(np.ones((4, 2))), [0] * 4,
+                       num_segments=1, op="max", method="matmul")
+    # empty leading axis: forced matmul degrades to the (identical)
+    # zeros result instead of crashing in a 0-size reshape
+    z = bolt.array(np.zeros((0, 3)), mesh)
+    out = segment_reduce(z, np.array([], dtype=np.int64), num_segments=4,
+                         method="matmul")
+    assert out.shape == (4, 3) and not np.asarray(out.toarray()).any()
