@@ -13,7 +13,7 @@ symbol-level citation, SURVEY.md §0):
 >>> b.map(lambda x: x + 1).sum().toarray()
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 from bolt_tpu.factory import (array, concatenate, fromcallback, full, ones,
                               rand, randn, zeros)

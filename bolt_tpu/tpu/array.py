@@ -293,6 +293,45 @@ def _canon(dtype):
     return jax.dtypes.canonicalize_dtype(np.dtype(dtype))
 
 
+def _complex_safe_get(x):
+    """``device_get`` that never ships a complex buffer over the wire.
+
+    Some attach transports (this environment's remote tunnel) have no
+    complex DMA: ONE attempted complex transfer fails UNIMPLEMENTED and
+    poisons every later transfer in the session.  Complex arrays
+    therefore fetch as two real views (one tiny fused program each)
+    combined on host; real arrays take the direct path unchanged."""
+    if not np.issubdtype(np.dtype(x.dtype), np.complexfloating):
+        return jax.device_get(x)
+    re, im = jax.device_get((jnp.real(x), jnp.imag(x)))
+    out = np.asarray(re) + 1j * np.asarray(im)
+    return out.astype(np.dtype(x.dtype), copy=False)
+
+
+def _complex_safe_put(a, sharding=None):
+    """host→device that never ships a complex buffer (the upload twin of
+    :func:`_complex_safe_get`): real and imag parts transfer separately
+    and ONE cached program combines them on device, already laid out on
+    ``sharding`` when given."""
+    a = np.asarray(a)
+    if not np.issubdtype(a.dtype, np.complexfloating):
+        return (jax.device_put(a, sharding) if sharding is not None
+                else jnp.asarray(a))
+    re = np.ascontiguousarray(a.real)
+    im = np.ascontiguousarray(a.imag)
+    if sharding is not None:
+        dre = jax.device_put(re, sharding)
+        dim = jax.device_put(im, sharding)
+    else:
+        dre, dim = jnp.asarray(re), jnp.asarray(im)
+
+    def build():
+        return jax.jit(jax.lax.complex)
+    fn = _cached_jit(("cplx_combine", tuple(a.shape), str(re.dtype),
+                      sharding), build)
+    return fn(dre, dim)
+
+
 def _check_live(arr):
     """Guard reads of a buffer that a ``swap(..., donate=True)`` may have
     consumed — deferred children can hold the donated parent's buffer."""
@@ -1311,7 +1350,7 @@ class BoltArrayTPU(BoltArray):
                     return other
             except Exception:
                 pass
-        return jnp.asarray(np.asarray(other))
+        return _complex_safe_put(np.asarray(other))
 
     def _coerce_bolt_operand(self, value, what):
         """Unwrap a possibly-bolt operand for a compiled program: a
@@ -1356,7 +1395,7 @@ class BoltArrayTPU(BoltArray):
             self._check_mesh(other, "elementwise")
             odata = other._data
         elif isinstance(other, BoltArray):
-            odata = jnp.asarray(other.toarray())
+            odata = _complex_safe_put(other.toarray())
         else:
             odata = self._coerce_operand(other)
         # numpy broadcasting is symmetric: the result may OUTGROW self
@@ -1444,7 +1483,7 @@ class BoltArrayTPU(BoltArray):
             self._check_mesh(other, op.__name__)
             odata = other._data
         elif isinstance(other, BoltArray):
-            odata = jnp.asarray(other.toarray())
+            odata = _complex_safe_put(other.toarray())
         else:
             odata = self._coerce_operand(other)
         # self.shape (not _aval, which is None on a pending filter result)
@@ -2023,7 +2062,7 @@ class BoltArrayTPU(BoltArray):
 
         out = _cached_jit(("item", funcs, base.shape, str(base.dtype),
                            split, multi, mesh), build)(_check_live(base))
-        return np.asarray(jax.device_get(out)).item()
+        return np.asarray(_complex_safe_get(out)).item()
 
     def tolist(self):
         """Nested Python lists of the gathered array (ndarray
@@ -2436,8 +2475,13 @@ class BoltArrayTPU(BoltArray):
             if (padded.is_fully_addressable
                     and padded.size * padded.dtype.itemsize
                     <= _PENDING_FETCH_MAX_BYTES):
-                p, c = jax.device_get((padded, cnt))
-                c = int(c)
+                if np.issubdtype(np.dtype(padded.dtype),
+                                 np.complexfloating):
+                    p = _complex_safe_get(padded)
+                    c = int(jax.device_get(cnt))
+                else:
+                    p, c = jax.device_get((padded, cnt))
+                    c = int(c)
                 # the count is on host now: resolve device-side without a
                 # second sync, releasing the padded buffer
                 self._resolve_pending(count=c)
@@ -2460,11 +2504,14 @@ class BoltArrayTPU(BoltArray):
             # memmap) — fetched in ONE batched device_get (per-shard
             # gets would pay a host round-trip EACH)
             shards = data.addressable_shards
-            blocks = jax.device_get([sh.data for sh in shards])
+            if np.issubdtype(np.dtype(data.dtype), np.complexfloating):
+                blocks = [_complex_safe_get(sh.data) for sh in shards]
+            else:
+                blocks = jax.device_get([sh.data for sh in shards])
             for sh, blk in zip(shards, blocks):
                 out[sh.index] = np.asarray(blk)
             return out
-        return np.asarray(jax.device_get(data))
+        return np.asarray(_complex_safe_get(data))
 
     def iter_shards(self):
         """Yield ``(index, block)`` for every shard THIS process can
@@ -2478,7 +2525,7 @@ class BoltArrayTPU(BoltArray):
         walking code can scribble without mode-dependent aliasing."""
         data = self._data
         for sh in data.addressable_shards:
-            yield sh.index, np.array(jax.device_get(sh.data))
+            yield sh.index, np.array(_complex_safe_get(sh.data))
 
     def _gather_multihost(self, data, out=None):
         """Shard-wise cross-host gather with bounded device memory at ANY
@@ -2514,7 +2561,7 @@ class BoltArrayTPU(BoltArray):
 
         # step 1: local shards, no communication
         for sh in data.addressable_shards:
-            out[sh.index] = np.asarray(jax.device_get(sh.data))
+            out[sh.index] = np.asarray(_complex_safe_get(sh.data))
 
         # step 2: deterministic region -> owner map (lowest device id)
         owners, procs = {}, {}
@@ -2627,8 +2674,8 @@ class BoltArrayTPU(BoltArray):
 
             fn = _cached_jit(("first", funcs, base.shape, str(base.dtype),
                               split, mesh), build)
-            return np.asarray(jax.device_get(fn(_check_live(base))))
-        return np.asarray(jax.device_get(self._data[(0,) * self._split]))
+            return np.asarray(_complex_safe_get(fn(_check_live(base))))
+        return np.asarray(_complex_safe_get(self._data[(0,) * self._split]))
 
     def _concat_many(self, others, axis):
         """Concatenate with any number of operands in ONE compiled

@@ -508,6 +508,13 @@ def _static_ranges(range):
 @_implements(np.histogram2d)
 def _histogram2d(x, y, bins=10, range=None, density=None, weights=None):
     _require_default(weights=(weights, None))
+    # numpy's eager contract, checked BEFORE tracing: mismatched lengths
+    # must be ITS ValueError, not a jax concat TypeError, and >1-d
+    # samples must not be silently flattened (ADVICE r4)
+    if np.ndim(x) > 1 or np.ndim(y) > 1:
+        raise _Fallback("non-1-d histogram2d samples")
+    if np.size(x) != np.size(y):
+        raise ValueError("x and y must have the same length.")
     bb = _static_bins(bins, 2)
     if bb is None:
         raise _Fallback("bin edges")
@@ -522,9 +529,12 @@ def _histogram2d(x, y, bins=10, range=None, density=None, weights=None):
 
     h, ex, ey = _device_fused("histogram2d", [x, y], anchor, (0, 0, 0),
                               body, (bb, rng_key, bool(density)))
-    # numpy returns float64 in BOTH branches (float counts / densities)
+    # numpy returns float64 everywhere here: counts/densities AND the
+    # edge vectors (which would otherwise come back f32 under
+    # production x64-off numerics — ADVICE r4)
     return (np.asarray(h.toarray()).astype(np.float64),
-            np.asarray(ex.toarray()), np.asarray(ey.toarray()))
+            np.asarray(ex.toarray()).astype(np.float64),
+            np.asarray(ey.toarray()).astype(np.float64))
 
 
 @_implements(np.histogramdd)
@@ -549,8 +559,11 @@ def _histogramdd(sample, bins=10, range=None, density=None,
     outs = _device_fused("histogramdd", [sample], sample,
                          (0,) * (1 + d), body,
                          (bb, rng_key, bool(density)))
+    # edges in float64 like the hist — numpy's dtype even under
+    # production x64-off numerics (ADVICE r4)
     return (np.asarray(outs[0].toarray()).astype(np.float64),
-            [np.asarray(e.toarray()) for e in outs[1:]])
+            [np.asarray(e.toarray()).astype(np.float64)
+             for e in outs[1:]])
 
 
 @_implements(np.bincount)
@@ -1014,10 +1027,11 @@ def _hstack(tup, *, dtype=None, casting="same_kind"):
     def target(sh):
         return (1,) if len(sh) == 0 else None
 
-    # numpy: concatenate axis 0 when everything is 1-d, else axis 1
+    # numpy decides the axis from the FIRST array alone (its error
+    # message for mixed 1-d/2-d operands depends on it — ADVICE r4)
     return _stack_like(
         "hstack", tup,
-        lambda effs: 0 if all(len(e) == 1 for e in effs) else 1, target)
+        lambda effs: 0 if len(effs[0]) == 1 else 1, target)
 
 
 @_implements(np.column_stack)

@@ -28,7 +28,7 @@ def main():
     env.pop("JAX_PLATFORMS", None)
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "-m", "chip", "-q",
-         "tests/test_chip.py"],
+         "tests/test_chip.py", "tests/test_chip_matrix.py"],
         cwd=ROOT, env=env, capture_output=True, text=True)
     tail = (proc.stdout.strip().splitlines() or ["(no output)"])[-1]
     print(proc.stdout[-4000:])

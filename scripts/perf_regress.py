@@ -122,7 +122,9 @@ def fam_map_sum():
     shape = (8192, 256, 256)                      # 2.1 GB f32
     b = bolt.ones(shape, mode="tpu", dtype=np.float32).cache()
     return int(np.prod(shape)) * 4, steady_amortized(
-        lambda: b.map(MAPSUM_FN).sum(axis=(0, 1, 2)))
+        lambda: b.map(MAPSUM_FN).sum(axis=(0, 1, 2))), {
+        "bound": "hbm",
+        "traffic": (1.0, "one fused read pass; output is a scalar")}
 
 
 def fam_stats_welford():
@@ -138,7 +140,9 @@ def fam_stats_welford():
     b.stats()
     prog = next(v for k, v in _JIT_CACHE.items() if k[0] == "welford")
     data = b._data
-    return nbytes, steady_amortized(lambda: prog(data))
+    return nbytes, steady_amortized(lambda: prog(data)), {
+        "bound": "hbm",
+        "traffic": (1.0, "one fused pallas read pass; moments are tiny")}
 
 
 def fam_swap():
@@ -151,7 +155,11 @@ def fam_swap():
     # Amortized queueing is safe — the runtime keeps ~2 executions in
     # flight, so 2.1 GB outputs never stack (measured: no OOM at 48).
     return int(np.prod(shape)) * 4, steady_amortized(
-        lambda: b.swap((0,), (0,)), iters=48)
+        lambda: b.swap((0,), (0,)), iters=48), {
+        "bound": "hbm",
+        "traffic": (2.0, "read + transposed write per byte (single "
+                         "chip; a mesh's all_to_all exchange rides on "
+                         "top)")}
 
 
 def fam_filter_fused():
@@ -166,7 +174,11 @@ def fam_filter_fused():
         out = arr.filter(FILTER_PRED)
         return BoltArrayTPU(out._pending[0], 1, arr.mesh)
 
-    return int(np.prod(shape)) * 4, steady_chain(b, step, iters=24)
+    return int(np.prod(shape)) * 4, steady_chain(b, step, iters=24), {
+        "bound": "hbm",
+        "traffic": (3.0, "mask + count + compact: ~3 passes over the "
+                         "input (round-3 measured ~330 GB/s real "
+                         "traffic)")}
 
 
 def fam_matmul():
@@ -204,7 +216,10 @@ def fam_halo_gaussian():
     b = bolt.randn(shape, mode="tpu", seed=6, dtype=np.float32).cache()
     return int(np.prod(shape)) * 4, steady_chain(
         b, lambda x: gaussian(x, sigma=2.0, axis=(0, 1), size="64"),
-        iters=12)
+        iters=12), {
+        "bound": "hbm",
+        "traffic": (4.0, "two per-axis kernel passes (sublane window + "
+                         "lane band matmul), each read + write")}
 
 
 def fam_segment_reduce():
@@ -218,7 +233,10 @@ def fam_segment_reduce():
 
     return int(np.prod(shape)) * 4, steady_amortized(
         lambda: segment_reduce(b, labels, num_segments=256, op="sum"),
-        iters=32)
+        iters=32), {
+        "bound": "hbm",
+        "traffic": (1.0, "one matmul read pass (one-hot path); the "
+                         "(256, V) output is ~3% of the input")}
 
 
 def fam_pca():
@@ -242,7 +260,10 @@ def fam_pca():
     # whole point is one pass over the data)
     return n * d * 4, sec, {"bound": "hbm",
                             "flops": 2 * n * d * d + 2 * n * d * k,
-                            "precision": "f32_highest"}
+                            "precision": "f32_highest",
+                            "traffic": (3.0, "mean + Gram + projection "
+                                             "each read the input once "
+                                             "(center=True)")}
 
 
 def fam_svdvals():
@@ -259,7 +280,9 @@ def fam_svdvals():
     sec = steady_amortized(lambda: fn(x), iters=24)
     return batch * n * d * 4, sec, {"bound": "hbm",
                                     "flops": 2 * batch * n * d * d,
-                                    "precision": "f32_highest"}
+                                    "precision": "f32_highest",
+                                    "traffic": (1.0, "one Gram read "
+                                                     "pass")}
 
 
 def fam_jacobi_eigh():
@@ -287,6 +310,20 @@ def fam_jacobi_eigh():
                                     "precision": "f32"}
 
 
+def fam_pca_default():
+    # the SAME pca program under the bolt.precision("default") scope —
+    # PERF.json records both policy modes for the precision-bound
+    # families (VERDICT r4 weak-3/4; measured 2.47x on chip, sv within
+    # 2e-5)
+    with bolt.precision("default"):
+        return fam_pca()
+
+
+def fam_halo_gaussian_default():
+    with bolt.precision("default"):
+        return fam_halo_gaussian()
+
+
 FAMILIES = [
     ("map_sum", fam_map_sum),
     ("stats_welford", fam_stats_welford),
@@ -295,14 +332,37 @@ FAMILIES = [
     ("matmul", fam_matmul),
     ("matmul_bf16", fam_matmul_bf16),
     ("halo_gaussian", fam_halo_gaussian),
+    ("halo_gaussian_default", fam_halo_gaussian_default),
     ("segment_reduce", fam_segment_reduce),
     ("pca", fam_pca),
+    ("pca_default", fam_pca_default),
     ("svdvals", fam_svdvals),
     ("jacobi_eigh", fam_jacobi_eigh),
 ]
 
 
+def print_table():
+    """Markdown perf table regenerated FROM PERF.json (BASELINE.md
+    pastes this between its PERF_TABLE markers — headline numbers come
+    from the artifact, never from memory)."""
+    with open(OUT) as f:
+        results = json.load(f)
+    print("| family | bound | GB/s (per input pass) | eff GB/s "
+          "(real traffic) | % of bound | TFLOP/s | % MXU peak |")
+    print("|---|---|---|---|---|---|---|")
+    for name in sorted(results):
+        r = results[name]
+        print("| %s | %s | %s | %s | %s | %s | %s |" % (
+            name, r.get("bound", ""), r.get("gbps", ""),
+            r.get("effective_gbps", ""),
+            r.get("pct_of_bound", r.get("pct_mxu_peak", "")),
+            r.get("tflops", ""), r.get("pct_mxu_peak", "")))
+
+
 def main():
+    if "--table" in sys.argv:
+        print_table()
+        return 0
     rebase = "--rebaseline" in sys.argv
     only = None
     for arg in sys.argv[1:]:
@@ -346,6 +406,19 @@ def main():
         # s_per_iter.
         if meta["bound"] == "hbm":
             entry["pct_hbm_peak"] = round(100.0 * gbps / HBM_PEAK_GBPS, 1)
+        if meta.get("traffic"):
+            # HONEST effective-traffic accounting (VERDICT r4 weak-2):
+            # gbps above is per-pass-over-the-INPUT; multi-pass families
+            # (swap ~2x, filter ~3x, halo ~4x) move more HBM bytes than
+            # the input per iteration, and the machine-readable % must
+            # say so instead of hiding it in prose
+            mult, model = meta["traffic"]
+            eff = nbytes * mult
+            entry["effective_bytes"] = int(eff)
+            entry["effective_gbps"] = round(eff / sec / 1e9, 1)
+            entry["pct_of_bound"] = round(
+                100.0 * entry["effective_gbps"] / HBM_PEAK_GBPS, 1)
+            entry["traffic_model"] = model
         if meta.get("flops"):
             tf = meta["flops"] / sec / 1e12
             entry["tflops"] = round(tf, 2)
@@ -384,10 +457,13 @@ def main():
             below.append(name)
             if r["gbps"] < b["gbps"] * (1 - THRESHOLD):
                 regressed.append((name, b["gbps"], r["gbps"]))
-        print("family %-15s %8.1f GB/s vs low-water %6.1f -> %s"
+        eff = ("  [eff %.0f GB/s = %.0f%% of bound]"
+               % (r["effective_gbps"], r["pct_of_bound"])
+               if "effective_gbps" in r else "")
+        print("family %-15s %8.1f GB/s vs low-water %6.1f -> %s%s"
               % (name, r["gbps"], b["gbps"],
                  "above" if ok else "BELOW (%.0f%%)"
-                 % (100.0 * r["gbps"] / b["gbps"])), file=sys.stderr)
+                 % (100.0 * r["gbps"] / b["gbps"]), eff), file=sys.stderr)
     for name, was, now in regressed:
         print("REGRESSION %s: %.1f -> %.1f GB/s" % (name, was, now),
               file=sys.stderr)

@@ -1558,3 +1558,48 @@ def test_tail9_split_bookkeeping(mesh):
     assert isinstance(outs, tuple) and outs[0].split == 1
     grids = np.meshgrid(b[:, 0, 0], np.arange(3.0))
     assert isinstance(grids, list)
+
+
+def test_advice_r4_edges(mesh):
+    """ADVICE r4 fixes: histogram2d validation + edge dtypes, hstack's
+    first-array axis rule."""
+    rs = np.random.RandomState(61)
+    b16 = bolt.array(rs.randn(16), mesh)
+    b8 = bolt.array(rs.randn(8), mesh)
+    # mismatched lengths: numpy's eager ValueError, not a trace error
+    with pytest.raises(ValueError, match="same length"):
+        np.histogram2d(b16, b8)
+    # >1-d samples are not silently flattened — numpy rejects them, and
+    # the host fallback surfaces its exact error on both backends
+    x2 = rs.randn(4, 4)
+    with pytest.raises(ValueError):
+        np.histogram2d(x2, x2)
+    with pytest.raises(ValueError):
+        np.histogram2d(bolt.array(x2, mesh), bolt.array(x2, mesh))
+    # edges come back float64 even under x64-off production numerics
+    h, ex, ey = np.histogram2d(b16, bolt.array(rs.randn(16), mesh))
+    assert ex.dtype == np.float64 and ey.dtype == np.float64
+    hd, edges = np.histogramdd(bolt.array(rs.randn(16, 3), mesh))
+    assert all(e.dtype == np.float64 for e in edges)
+    # hstack with a 1-d first operand and 2-d second: numpy's error
+    # (decided from the FIRST array alone) on both backends
+    for first, second in ((b16, rs.randn(2, 2)),):
+        with pytest.raises(ValueError):
+            np.hstack([first, second])
+        with pytest.raises(ValueError):
+            np.hstack([np.asarray(first), second])
+
+
+def test_every_table_entry_documented():
+    """Every ``_TABLE`` entry must appear by name in docs/API.md's
+    inventory (VERDICT r4 hygiene: headline claims regenerate from
+    artifacts — the doc list cannot silently lag the dispatch table)."""
+    import os
+    api_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "API.md")
+    with open(api_path) as f:
+        api = f.read()
+    missing = sorted({f.__name__ for f in npdispatch._TABLE
+                      if f.__name__ not in api})
+    assert not missing, "npdispatch._TABLE entries undocumented in " \
+        "docs/API.md: %s" % missing

@@ -3,7 +3,10 @@ layouts, collective-backed ops, multi-axis meshes (the reference covers
 distribution semantically via local-mode Spark — SURVEY §4; here we
 additionally assert on the placement itself)."""
 
+import os
+
 import numpy as np
+import pytest
 
 import jax
 import bolt_tpu as bolt
@@ -148,3 +151,24 @@ def test_shard_gather_assembly(mesh):
         "regions": 0, "broadcasts": 0, "max_piece_bytes": 0}
     # the cross-process piece-broadcast path (bounded max_piece_bytes,
     # region splitting) is exercised for real in scripts/multihost_smoke.py
+
+
+@pytest.mark.parametrize("n", [2, 3, 5])
+def test_dryrun_multichip_device_counts(n):
+    """The full multichip gate at even AND odd device counts (VERDICT r4
+    weak-6: the 1-d-mesh branch and the indivisible-key replication
+    fallbacks only run when n is odd).  Fresh subprocess per count —
+    the virtual device count is fixed at backend init."""
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=%d" % n)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(%d)" % n],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-2000:]

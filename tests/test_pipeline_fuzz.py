@@ -382,7 +382,11 @@ _LOCAL_OPS = [_lop_map, _op_operator, _op_slice0, _op_clip, _lop_filter,
               _lop_chunked_map, _lop_stacked_map, _lop_smooth,
               _lop_concat_self, _lop_normalize, _op_ufunc, _lop_matmul,
               _op_set, _op_np_sort, _op_take0, _op_ufunc_method,
-              _op_np_delete, _op_np_take_along]
+              _op_np_delete]
+# _op_np_take_along is TPU-only: numpy's take_along_axis drives fancy
+# indexing that the local array's orthogonal-indexing contract restricts
+# (reference-faithful — upstream's ndarray subclass restricts the same
+# way), so the local oracle rejects what the device backend serves
 
 
 @given(st.data(), st.integers(0, 2 ** 16), st.integers(2, 5))
