@@ -105,7 +105,28 @@ def bench_tpu(shape, pipe_iters=50):
     return out, steady, synced
 
 
+def _engine_stats():
+    """Compile-cache accounting for the result line: hit rate over the
+    run, explicit XLA compile seconds, and whether the persistent
+    on-disk cache (BOLT_PERSISTENT_CACHE=<dir>) served them."""
+    from bolt_tpu import profile
+    c = profile.engine_counters()
+    lookups = c["hits"] + c["misses"]
+    return {
+        "cache_hit_rate": round(c["hits"] / lookups, 4) if lookups else None,
+        "aot_compiles": c["aot_compiles"],
+        "compile_seconds": round(c["compile_seconds"], 3),
+        "persistent_hits": c["persistent_hits"],
+    }
+
+
 def main():
+    pc = os.environ.get("BOLT_PERSISTENT_CACHE")
+    if pc:
+        from bolt_tpu import engine
+        engine.persistent_cache(pc)
+        _log("persistent compile cache: %s" % pc)
+
     # ---- config 1: parity anchor ------------------------------------
     _log("config 1 %s (%.2f GB): local baseline..." % (SHAPE1, _gb(SHAPE1)))
     local_out, local_t = bench_local_config1()
@@ -148,6 +169,7 @@ def main():
             "vs_baseline": round(local_t / tpu1_t, 3),
         }
 
+    result["engine"] = _engine_stats()
     print(json.dumps(result))
 
 

@@ -20,7 +20,7 @@ from bolt_tpu.factory import (array, concatenate, fromcallback, full, ones,
 from bolt_tpu.base import BoltArray, HostFallbackWarning
 from bolt_tpu.local.array import BoltArrayLocal
 from bolt_tpu.tpu.array import BoltArrayTPU
-from bolt_tpu.precision import precision
+from bolt_tpu._precision import precision
 from bolt_tpu.utils import allclose
 
 __all__ = ["array", "ones", "zeros", "full", "rand", "randn",
@@ -28,8 +28,8 @@ __all__ = ["array", "ones", "zeros", "full", "rand", "randn",
            "BoltArray", "BoltArrayLocal", "BoltArrayTPU",
            "HostFallbackWarning", "__version__"]
 
-_SUBMODULES = ("checkpoint", "profile", "parallel", "ops", "statcounter",
-               "utils")
+_SUBMODULES = ("checkpoint", "engine", "profile", "parallel", "ops",
+               "statcounter", "utils")
 
 
 def __getattr__(name):

@@ -1,0 +1,364 @@
+"""Central dispatch engine: ONE keyed ahead-of-time executable cache.
+
+Every op family used to hand-roll the same plumbing — a bounded
+``OrderedDict`` LRU of jitted callables keyed on (op, funcs, geometry) —
+in ``tpu/array.py`` and re-import it everywhere else.  That worked, but a
+production executor needs three things the scattered version could not
+give:
+
+1. **Ahead-of-time compilation with visibility.**  ``get(key, builder)``
+   returns a dispatcher that lowers and compiles the jitted program
+   explicitly (``jit(f).lower(*args).compile()``) per argument signature,
+   so the engine knows exactly when XLA compilation happens and how long
+   it took — exported as the ``aot_compiles`` / ``compile_seconds``
+   counters — instead of compilation hiding inside jit's first call.
+   Dispatch then goes straight to the compiled executable.
+
+2. **Cross-process persistence.**  :func:`persistent_cache` opts in to
+   JAX's on-disk compilation cache (``jax_compilation_cache_dir`` with
+   the min-time/min-size floors dropped to zero), so a warm process
+   re-lowers but skips XLA compilation entirely: the second run of an
+   identical pipeline in a fresh process shows ``compile_seconds ≈ 0``.
+
+3. **Hit/miss accounting.**  ``hits``/``misses`` count executable-cache
+   lookups at the key level, ``dispatches``/``dispatch_seconds`` the
+   host-side cost of launching (launches are async; device completion is
+   :func:`bolt_tpu.profile.timeit`'s job).  Snapshot via
+   :func:`counters`; ``bolt_tpu.profile`` re-exports a formatted report.
+
+The engine also owns the **donation policy** for pipeline terminals:
+``reduce``/``_stat``/chained-``map`` materialisation/``chunk().map``
+donate a deferred chain's base buffer to XLA when (a) the chain is that
+buffer's sole owner (no other live array wraps it) and (b) the buffer is
+at least :func:`donation_min_bytes` big — halving peak HBM for one-shot
+``ones(10GB).map(f).sum()``-style chains, where input + intermediate
+cannot coexist.  A donated parent becomes unreadable (the same guard as
+``swap(donate=True)``); the size floor keeps small interactive arrays
+reusable.  ``donation(min_bytes)`` scopes the policy; ``donation(None)``
+disables it.
+
+Keys follow the established convention: (op-tag, user funcs, shape,
+dtype, split, mesh, precision/extras) — hashable, and holding no array
+references, so cached entries pin no device memory.
+"""
+
+import contextlib
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import jax
+
+# ---------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------
+
+CACHE_MAX = 512                      # keyed entries (same bound as before)
+
+# AOT can be turned off (pure jit dispatch, still keyed + counted) for
+# debugging signature mismatches: BOLT_ENGINE_AOT=0
+_AOT = os.environ.get("BOLT_ENGINE_AOT", "1").lower() not in ("0", "false")
+
+# donation floor: terminals donate sole-owned chain bases at or above
+# this size.  The default is deliberately HBM-scale (64 MB): donation's
+# win is one-shot multi-GB chains where input + intermediate cannot
+# coexist, while its cost — the consumed array can serve only ONE
+# terminal — would surprise interactive reuse of modest arrays.  Arrays
+# below the floor stay readable after any number of terminals.
+# None = off entirely.
+_DONATE_MIN_BYTES = int(os.environ.get("BOLT_DONATE_MIN_BYTES",
+                                       str(64 << 20)))
+
+_LOCK = threading.RLock()
+_CACHE = OrderedDict()               # key -> _Entry
+
+_COUNTERS = {
+    "hits": 0,                # get() found the key
+    "misses": 0,              # get() built a new entry (builder ran)
+    "aot_compiles": 0,        # explicit lower+compile runs
+    "lower_seconds": 0.0,     # wall time tracing/lowering (every process
+                              # pays this; it is host work, not XLA)
+    "compile_seconds": 0.0,   # wall time inside XLA compilation — the
+                              # persistent cache drives this to ~0 in a
+                              # warm process
+    "dispatches": 0,          # executions dispatched through the engine
+    "dispatch_seconds": 0.0,  # host-side dispatch wall time (async)
+    "fallbacks": 0,           # dispatches that bypassed the AOT path
+    "donations": 0,           # terminal buffer donations granted
+    "persistent_hits": 0,     # XLA compiles served from the on-disk cache
+    "persistent_misses": 0,   # XLA compiles that had to run for real
+}
+
+_MONITORING_HOOKED = False
+
+
+def _hook_persistent_monitoring():
+    """Count the on-disk cache's hits/misses via jax's monitoring events
+    (the only public signal of whether ``.compile()`` loaded from disk)."""
+    global _MONITORING_HOOKED
+    if _MONITORING_HOOKED:
+        return
+    try:
+        from jax import monitoring
+
+        def listen(event, **kwargs):
+            if event == "/jax/compilation_cache/cache_hits":
+                with _LOCK:
+                    _COUNTERS["persistent_hits"] += 1
+            elif event == "/jax/compilation_cache/cache_misses":
+                with _LOCK:
+                    _COUNTERS["persistent_misses"] += 1
+
+        monitoring.register_event_listener(listen)
+        _MONITORING_HOOKED = True
+    except Exception:
+        pass
+
+
+def counters():
+    """A snapshot dict of the engine counters (monotonic within a
+    process; :func:`reset_counters` zeroes them)."""
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_counters():
+    with _LOCK:
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0 if isinstance(_COUNTERS[k], int) else 0.0
+
+
+def clear():
+    """Drop every cached executable (counters are left alone)."""
+    with _LOCK:
+        _CACHE.clear()
+
+
+def cache_len():
+    return len(_CACHE)
+
+
+# ---------------------------------------------------------------------
+# persistent on-disk compilation cache
+# ---------------------------------------------------------------------
+
+_PERSISTENT_DIR = None
+
+
+def persistent_cache(cache_dir=None, enable=True):
+    """Opt in to JAX's persistent on-disk XLA compilation cache.
+
+    ::
+
+        bolt_tpu.engine.persistent_cache("/var/cache/bolt-xla")
+
+    Compiled programs are written under ``cache_dir`` (default
+    ``~/.cache/bolt_tpu/xla``); a fresh process running the same pipeline
+    re-lowers but loads the executable from disk instead of invoking XLA
+    — the engine's ``compile_seconds`` counter stays ≈ 0 on the warm run.
+    The min-compile-time and min-entry-size floors are dropped to zero so
+    EVERY program persists (this framework's programs are many and
+    individually cheap; the default floors would skip most of them).
+
+    ``enable=False`` detaches the directory (in-memory caching only).
+    Returns the resolved directory (or ``None`` when disabling)."""
+    global _PERSISTENT_DIR
+    _hook_persistent_monitoring()
+    if not enable:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_jax_cache_singleton()
+        _PERSISTENT_DIR = None
+        return None
+    if cache_dir is None:
+        cache_dir = os.path.join(os.path.expanduser("~"), ".cache",
+                                 "bolt_tpu", "xla")
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        jax.config.update("jax_enable_compilation_cache", True)
+    except AttributeError:      # flag spelling varies across versions
+        pass
+    _reset_jax_cache_singleton()
+    _PERSISTENT_DIR = cache_dir
+    return cache_dir
+
+
+def _reset_jax_cache_singleton():
+    """jax initialises its compilation-cache object once per process;
+    flipping the directory afterwards needs an explicit reset or the old
+    (absent) cache keeps being consulted."""
+    try:
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
+
+
+def persistent_cache_dir():
+    """The active on-disk cache directory, or ``None``."""
+    return _PERSISTENT_DIR
+
+
+# ---------------------------------------------------------------------
+# donation policy
+# ---------------------------------------------------------------------
+
+def donation_min_bytes():
+    """Current donation floor in bytes, or ``None`` when terminal
+    donation is disabled."""
+    return _DONATE_MIN_BYTES
+
+
+def set_donation_min_bytes(n):
+    """Set the donation floor (``None`` disables terminal donation)."""
+    global _DONATE_MIN_BYTES
+    _DONATE_MIN_BYTES = None if n is None else int(n)
+
+
+@contextlib.contextmanager
+def donation(min_bytes):
+    """Scope the terminal-donation floor::
+
+        with bolt_tpu.engine.donation(0):      # donate at any size
+            out = bolt.ones(shape, mesh).map(f).sum()
+
+    ``donation(None)`` disables donation inside the scope."""
+    prev = _DONATE_MIN_BYTES
+    set_donation_min_bytes(min_bytes)
+    try:
+        yield
+    finally:
+        set_donation_min_bytes(prev)
+
+
+def donation_granted():
+    """Count a granted terminal donation (called by the op layers)."""
+    with _LOCK:
+        _COUNTERS["donations"] += 1
+
+
+# ---------------------------------------------------------------------
+# the keyed AOT dispatch path
+# ---------------------------------------------------------------------
+
+def _leaf_sig(x):
+    """Signature of one argument leaf: enough to pick a compiled
+    executable — aval (shape/dtype) plus sharding for device arrays,
+    shape/dtype for host arrays, the Python type for scalars (weak-type
+    avals differ by type, and ``0 == 0.0`` would collide under equality
+    hashing)."""
+    if isinstance(x, jax.Array):
+        return ("j", x.shape, str(x.dtype), x.sharding)
+    shape = getattr(x, "shape", None)
+    if shape is not None:
+        return ("h", tuple(shape), str(getattr(x, "dtype", "")))
+    return ("s", type(x))
+
+
+class _Dispatch:
+    """The callable ``get`` returns: routes a call to the per-signature
+    compiled executable, lowering+compiling (counted) on first sight of a
+    signature; falls back to plain jit dispatch for argument structures
+    the AOT path cannot serve (and counts the fallback)."""
+
+    __slots__ = ("jitted", "compiled")
+
+    def __init__(self, jitted):
+        self.jitted = jitted
+        self.compiled = {}           # signature -> compiled executable
+
+    def lower(self, *args, **kwargs):
+        """Delegate to the wrapped jitted callable so cached entries stay
+        inspectable (``entry.lower(x).compile().as_text()`` — the
+        HLO-contract tests read collectives out of cached programs)."""
+        return self.jitted.lower(*args, **kwargs)
+
+    def __call__(self, *args):
+        t0 = time.perf_counter()
+        try:
+            out = self._dispatch(args)
+        finally:
+            with _LOCK:
+                _COUNTERS["dispatches"] += 1
+                _COUNTERS["dispatch_seconds"] += time.perf_counter() - t0
+        return out
+
+    def _dispatch(self, args):
+        if not _AOT:
+            with _LOCK:
+                _COUNTERS["fallbacks"] += 1
+            return self.jitted(*args)
+        try:
+            leaves, treedef = jax.tree_util.tree_flatten(args)
+            sig = (treedef, tuple(_leaf_sig(x) for x in leaves))
+        except Exception:
+            sig = None
+        if sig is not None:
+            fn = self.compiled.get(sig)
+            if fn is None:
+                try:
+                    t0 = time.perf_counter()
+                    lowered = self.jitted.lower(*args)
+                    t1 = time.perf_counter()
+                    fn = lowered.compile()
+                    t2 = time.perf_counter()
+                    with _LOCK:
+                        _COUNTERS["aot_compiles"] += 1
+                        _COUNTERS["lower_seconds"] += t1 - t0
+                        _COUNTERS["compile_seconds"] += t2 - t1
+                    self.compiled[sig] = fn
+                except Exception:
+                    fn = None
+            if fn is not None:
+                try:
+                    return fn(*args)
+                except (TypeError, ValueError):
+                    # argument-validation drift the leaf model missed
+                    # (layouts, committed-device nuances) — raised BEFORE
+                    # execution, so inputs (donated ones included) are
+                    # intact and the jitted path below is safe.  Genuine
+                    # runtime failures (XlaRuntimeError: OOM, nan checks,
+                    # asserts) propagate — re-running them would double
+                    # work and bury the real error.
+                    pass
+        with _LOCK:
+            _COUNTERS["fallbacks"] += 1
+        return self.jitted(*args)
+
+
+def get(key, builder):
+    """The engine's dispatch lookup — the drop-in replacement for the old
+    per-module ``_cached_jit``: returns a callable executing the program
+    ``builder`` describes, compiled at most once per (key, argument
+    signature) and shared LRU-style across every op family.
+
+    ``builder`` must return a jitted callable (``jax.jit(f, ...)``) whose
+    closure captures only geometry — never arrays (cached entries must
+    not pin device memory).  ``key`` must be hashable and must determine
+    the traced program (op tag, user funcs, shapes, dtypes, split, mesh,
+    precision, donation flag, ...)."""
+    with _LOCK:
+        entry = _CACHE.get(key)
+        if entry is not None:
+            _COUNTERS["hits"] += 1
+            _CACHE.move_to_end(key)
+            return entry
+        _COUNTERS["misses"] += 1
+    # build OUTSIDE the lock: builders may trace (slow) and re-enter
+    entry = _Dispatch(builder())
+    with _LOCK:
+        _CACHE[key] = entry
+        if len(_CACHE) > CACHE_MAX:
+            _CACHE.popitem(last=False)
+    return entry
+
+
+def evict(key):
+    """Drop one keyed entry (compile-failure fallbacks memoise around a
+    poisoned key)."""
+    with _LOCK:
+        _CACHE.pop(key, None)

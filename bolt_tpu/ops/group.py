@@ -14,6 +14,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu._compat import shard_map as _shard_map
+
 _OPS = ("sum", "mean", "max", "min")
 
 
@@ -85,7 +87,7 @@ def segment_reduce(b, labels, num_segments=None, op="sum", method=None,
         raise ValueError(
             "method='matmul' serves sum/mean of real floating (or "
             "int-mean) data only, got op=%r dtype=%s" % (op, b.dtype))
-    from bolt_tpu.precision import resolve
+    from bolt_tpu._precision import resolve
     pr = resolve(precision)
     from bolt_tpu.base import BoltArray
     if b.mode == "tpu":
@@ -587,7 +589,7 @@ def _unique_sharded(b, return_counts):
         def local(blk):
             flat, mask, cnt = _sort_mask(blk.reshape(-1))
             return flat[None], mask[None], cnt[None]
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local, mesh=mesh, in_specs=spec,
             out_specs=(out_spec, out_spec, out_spec)))
 
@@ -602,7 +604,7 @@ def _unique_sharded(b, return_counts):
             out = _gather_uniques(s_ref[0], m_ref[0], s_ref.shape[1],
                                   kpad, return_counts)
             return tuple(o[None] for o in out)
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             gather, mesh=mesh, in_specs=(out_spec, out_spec),
             out_specs=(out_spec,) * (2 if return_counts else 1)))
 

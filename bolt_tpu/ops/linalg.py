@@ -494,7 +494,7 @@ def pca(b, k=None, center=False, axis=None, return_mean=False,
     (BASELINE round-4 MFU table).  The local oracle always computes in
     f64.
     """
-    from bolt_tpu.precision import resolve
+    from bolt_tpu._precision import resolve
     pr = resolve(precision)
     mode, b, x_full, split, shape, n, d = _samples_features(
         b, axis, "pca", hint="; for plain matrices use tallskinny_pca")
@@ -644,7 +644,7 @@ def cov(b, axis=None, center=True, ddof=1, return_mean=False,
     reference (its ecosystem computes this via per-chunk jobs).
     ``precision=None`` resolves through the scoped policy like
     :func:`pca` (the Gram matmul is the cost)."""
-    from bolt_tpu.precision import resolve
+    from bolt_tpu._precision import resolve
     pr = resolve(precision)
     mode, b, x_full, split, shape, n, d = _samples_features(b, axis, "cov")
     if n - ddof <= 0:

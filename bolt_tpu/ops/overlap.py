@@ -134,7 +134,7 @@ def _separable_filter(b, taps_list, axes, size, mode, shard=None,
     (unplannable geometry, non-float dtype, a failed compile on this
     toolchain) falls back to the halo-chunked machinery, which also
     serves ``shard=`` (sequence-parallel) and the local oracle."""
-    from bolt_tpu.precision import resolve
+    from bolt_tpu._precision import resolve
     pr = resolve(precision)
     mode = _canon_mode(mode)
     depth = tuple(len(t) // 2 for t in taps_list)

@@ -35,7 +35,8 @@ def exchange_halo(local, pad, axis, axis_name, mode="zero"):
         raise ValueError(
             "halo pad %d exceeds the per-shard extent %d on axis %d"
             % (pad, local.shape[axis], axis))
-    n = jax.lax.axis_size(axis_name)
+    from bolt_tpu._compat import axis_size
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
 
     def take(arr, sl):

@@ -39,8 +39,8 @@ def make_mesh(shape, axis_names, devices=None):
     if devices is not None:
         return jax.sharding.Mesh(
             np.asarray(devices).reshape(shape), tuple(axis_names))
-    auto = (jax.sharding.AxisType.Auto,) * len(tuple(axis_names))
-    return jax.make_mesh(tuple(shape), tuple(axis_names), axis_types=auto)
+    from bolt_tpu._compat import make_mesh as _make_mesh
+    return _make_mesh(shape, axis_names)
 
 
 def ensure_auto(mesh):
@@ -49,10 +49,9 @@ def ensure_auto(mesh):
     ``jax.make_mesh`` defaults to Explicit axis types in recent JAX; this
     framework's lowering uses ``with_sharding_constraint`` + GSPMD
     propagation, which requires Auto axes, so user-supplied meshes are
-    normalised on entry."""
-    if all(t == jax.sharding.AxisType.Auto for t in mesh.axis_types):
-        return mesh
-    return jax.sharding.Mesh(mesh.devices, mesh.axis_names)
+    normalised on entry (identity on runtimes without typed mesh axes)."""
+    from bolt_tpu._compat import ensure_auto_mesh
+    return ensure_auto_mesh(mesh)
 
 
 def initialize_distributed(coordinator_address=None, num_processes=None,

@@ -155,6 +155,39 @@ def report(stats):
     return "\n".join(lines)
 
 
+def engine_counters():
+    """Snapshot of the central dispatch engine's counters (see
+    :mod:`bolt_tpu.engine`): executable-cache ``hits``/``misses``,
+    ``aot_compiles`` with ``lower_seconds``/``compile_seconds`` split
+    (the persistent on-disk cache drives ``compile_seconds`` to ~0 in a
+    warm process), ``dispatches``/``dispatch_seconds`` host-side launch
+    accounting, ``fallbacks`` (dispatches the AOT path could not serve),
+    ``donations`` (terminal buffer donations granted), and
+    ``persistent_hits``/``persistent_misses`` for the on-disk XLA
+    cache."""
+    from bolt_tpu import engine
+    return engine.counters()
+
+
+def reset_engine_counters():
+    from bolt_tpu import engine
+    engine.reset_counters()
+
+
+def engine_report(counters=None):
+    """Human-readable table of the engine counters::
+
+        print(bolt_tpu.profile.engine_report())
+    """
+    c = engine_counters() if counters is None else counters
+    lines = ["%-20s %12s" % ("counter", "value")]
+    for k in sorted(c):
+        v = c[k]
+        lines.append("%-20s %12s"
+                     % (k, ("%.4f" % v) if isinstance(v, float) else v))
+    return "\n".join(lines)
+
+
 def memory_stats(device=None):
     """Per-device memory counters (HBM on TPU) as a dict, or ``{}`` where
     the backend doesn't expose them.  Keys follow the PJRT convention

@@ -36,6 +36,7 @@ lose — ``toarray`` is key-ordered by construction, matching the reference's
 sorted collect).
 """
 
+import sys
 import warnings
 from collections import OrderedDict
 from functools import lru_cache
@@ -45,6 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu import engine as _engine
 from bolt_tpu.base import BoltArray, HostFallbackWarning
 from bolt_tpu.parallel.sharding import key_sharding
 from bolt_tpu.utils import (argpack, check_value_shape as _check_value_shape,
@@ -53,12 +55,16 @@ from bolt_tpu.utils import (argpack, check_value_shape as _check_value_shape,
 
 # Compiled-executable cache keyed on (operation, user function, static
 # geometry): repeated calls with the same func/shape reuse the executable
-# (the analog of Spark reusing a cached stage).  Bounded LRU so long
-# sessions with many distinct lambdas don't grow without limit; closures in
-# the cache deliberately capture only (mesh, geometry) — never an array —
-# so cached entries pin no device memory.
-_JIT_CACHE = OrderedDict()
-_JIT_CACHE_MAX = 512
+# (the analog of Spark reusing a cached stage).  The table itself now
+# lives in the central dispatch engine (bolt_tpu/engine.py) — one keyed
+# AOT compile cache for every op family, with hit/miss/compile-time
+# counters and optional on-disk persistence — and is aliased here for
+# introspection: tests and tools scan its keys, and entries answer
+# ``.lower`` like the jitted callables they wrap.  Closures in the cache
+# deliberately capture only (mesh, geometry) — never an array — so
+# cached entries pin no device memory.
+_JIT_CACHE = _engine._CACHE
+_JIT_CACHE_MAX = _engine.CACHE_MAX
 
 # stable callables for scalar operator operands (see _scalar_fn)
 _SCALAR_FN_CACHE = OrderedDict()
@@ -213,7 +219,7 @@ _LAST_GATHER_STATS = None
 
 
 def _lru_get(cache, key, build):
-    """Shared bounded-LRU policy for the executable and aval caches.
+    """Shared bounded-LRU policy for the aval/scalar-callable caches.
     NOTE: keys hold strong references to user callables, so a closure
     capturing a large array stays alive until its entry evicts — the
     values are the cheap part (executables/avals), the keys are what can
@@ -230,7 +236,46 @@ def _lru_get(cache, key, build):
 
 
 def _cached_jit(key, builder):
-    return _lru_get(_JIT_CACHE, key, builder)
+    """Keyed executable dispatch through the central engine: compiled at
+    most once per (key, argument signature), AOT, counted, and shared
+    across every op family (``bolt_tpu.profile.instrument`` patches this
+    name per module to count calls/builds)."""
+    return _engine.get(key, builder)
+
+
+def _chain_donate_ok(chain):
+    """True when a deferred chain's base buffer may be DONATED to the
+    compiled program of a consuming terminal (reduce/_stat/chain
+    materialisation/chunked map): the chain tuple must be the buffer's
+    sole owner — no other live bolt array wraps it and no other chain
+    shares it — and the buffer must be at least
+    ``engine.donation_min_bytes()`` big (small interactive arrays stay
+    readable after a terminal; HBM-scale one-shot chains get input+output
+    overlap, halving their peak footprint).
+
+    Ownership is decided by Python refcounts, twice over: the BASE must
+    have exactly three references (the chain tuple, our local, and
+    getrefcount's argument), and the chain TUPLE itself must be owned by
+    exactly one wrapper (``_clone`` copies share the tuple — a shared
+    tuple means another live array can still re-materialise from the
+    base, so donation must not fire).  Callers MUST invoke this before
+    binding their own local to the base (a fourth reference would mask
+    sole ownership, failing safe: no donation)."""
+    base = chain[0]
+    floor = _engine.donation_min_bytes()
+    if floor is None or base.nbytes < floor:
+        return False
+    if getattr(base, "is_deleted", lambda: False)():
+        return False
+    # chain refs when unshared: the owner's attribute, the caller's
+    # argument-stack slot, our parameter, getrefcount's argument — a
+    # fifth means a _clone shares the tuple (threshold verified by
+    # tests/test_engine.py::test_clone_shared_chain_blocks_donation on
+    # both the shared and unshared sides, so an interpreter that changes
+    # call-stack refcounting fails loudly there, not silently here)
+    if sys.getrefcount(chain) > 4:
+        return False
+    return sys.getrefcount(base) <= 3
 
 
 # abstract-shape inference results, keyed on (func identity, input aval):
@@ -417,6 +462,12 @@ class BoltArrayTPU(BoltArray):
         # scalar) from filter() — the survivor count has not been read on
         # host yet, so the logical shape is not known (see filter())
         self._pending = None
+        # deferred filter: (base, funcs, predicate, parent_split, vshape,
+        # n, value dtype) — no program has been DISPATCHED yet, so a
+        # reduction terminal can fold the predicate into its own pass
+        # (see filter / _fused_filter_stat); any other consumer resolves
+        # it into the _pending compaction form first
+        self._fpending = None
         self._donated = False
         self._aval = None if data is None else jax.ShapeDtypeStruct(
             data.shape, data.dtype)
@@ -434,12 +485,17 @@ class BoltArrayTPU(BoltArray):
 
     @property
     def shape(self):
+        if self._fpending is not None:
+            self._resolve_fpending()
         if self._pending is not None:
             self._resolve_pending()
         return tuple(self._aval.shape)
 
     @property
     def dtype(self):
+        if self._fpending is not None:
+            # dtype is known without dispatching the filter program
+            return np.dtype(self._fpending[6])
         if self._pending is not None:
             # dtype is known without syncing the survivor count
             return np.dtype(self._pending[0].dtype)
@@ -467,13 +523,65 @@ class BoltArrayTPU(BoltArray):
         compacted data lives on device, but the logical shape is unknown
         until one scalar fetch.  Reading ``shape`` (or any consumer)
         resolves it; ``toarray`` resolves it with a single batched
-        transfer."""
-        return self._pending is not None
+        transfer.  A still-DEFERRED filter (no program dispatched yet —
+        reductions fuse the predicate into their own pass) reports
+        pending too: its survivor count is equally unknown."""
+        return self._pending is not None or self._fpending is not None
+
+    def _consume_donated(self):
+        """Mark this array consumed by a donating pipeline terminal: its
+        chain base buffer was handed to XLA, so the chain can never be
+        re-materialised — reads now raise the same guard as
+        ``swap(donate=True)``."""
+        self._chain = None
+        self._concrete = None
+        self._fpending = None
+        self._donated = True
+        _engine.donation_granted()
+
+    def _resolve_fpending(self):
+        """Dispatch the deferred filter's fused compaction program (ONE
+        compiled pass: map chain + predicate + stable compaction + count)
+        — the result becomes a *pending* ``(padded, count)`` pair exactly
+        as the eager fused filter produced; the survivor count stays on
+        device until the shape is read.  A sole-owned base donates its
+        buffer to the program (the compaction buffer is input-sized)."""
+        if self._fpending is None:
+            return
+        donate = _chain_donate_ok(self._fpending)   # [0] is the base
+        base, funcs, func, split, vshape, n, _ = self._fpending
+        mesh = self._mesh
+
+        def build():
+            def fused(data):
+                mapped = _chain_apply(funcs, split, data)
+                flat = mapped.reshape((n,) + vshape)
+                mask = jax.vmap(
+                    lambda v: jnp.asarray(func(v), dtype=bool).reshape(()))(flat)
+                # survivor indices in increasing (key) order, padded with 0s
+                # beyond the count — rows past the count are garbage and are
+                # sliced away at resolution
+                perm = jnp.nonzero(mask, size=n, fill_value=0)[0]
+                padded = jnp.take(flat, perm, axis=0)
+                return (_constrain(padded, mesh, 1),
+                        jnp.sum(mask, dtype=jnp.int32))
+            return jax.jit(fused, donate_argnums=(0,) if donate else ())
+
+        fn = _cached_jit(("filter-fused", func, funcs, base.shape,
+                          str(base.dtype), split, donate, mesh), build)
+        padded, cnt = fn(_check_live(base))
+        self._fpending = None
+        self._pending = (padded, cnt)
+        if donate:
+            _engine.donation_granted()
 
     def _resolve_pending(self, count=None):
         """Slice the padded on-device buffer down to the true
         ``(n, *value_shape)``; syncs the survivor count (one scalar host
-        fetch) unless the caller already knows it."""
+        fetch) unless the caller already knows it.  A still-deferred
+        filter dispatches its compaction program first."""
+        if self._fpending is not None:
+            self._resolve_fpending()
         if self._pending is None:
             return
         padded, cnt = self._pending
@@ -501,22 +609,31 @@ class BoltArrayTPU(BoltArray):
         if self._donated:
             raise RuntimeError(
                 "this array's device buffer was donated to a swap(...,"
-                " donate=True); it can no longer be read")
+                " donate=True) or consumed by a donating pipeline "
+                "terminal; it can no longer be read")
+        if self._fpending is not None:
+            self._resolve_fpending()
         if self._pending is not None:
             self._resolve_pending()
         if self._concrete is None:
+            # chained-map terminal: a sole-owned base donates its buffer
+            # to the materialising program (the output is input-sized, so
+            # XLA aliases them — one buffer instead of two)
+            donate = _chain_donate_ok(self._chain)
             base, funcs = self._chain
             mesh, split = self._mesh, self._split
 
             def build():
                 def run(d):
                     return _constrain(_chain_apply(funcs, split, d), mesh, split)
-                return jax.jit(run)
+                return jax.jit(run, donate_argnums=(0,) if donate else ())
 
             fn = _cached_jit(("chain", funcs, base.shape, str(base.dtype),
-                              split, mesh), build)
+                              split, donate, mesh), build)
             self._concrete = fn(_check_live(base))
             self._chain = None
+            if donate:
+                _engine.donation_granted()
         return _check_live(self._concrete)
 
     def _chain_parts(self):
@@ -695,28 +812,15 @@ class BoltArrayTPU(BoltArray):
             # survivor-count rows only) at the cost of an eager count sync
             return self._filter_eager(func, aligned, split, vshape, n, mesh)
 
+        # DEFER: no program dispatches here.  A reduction terminal
+        # (sum/mean/reduce/...) folds the predicate into its own pass —
+        # ONE read of HBM, no compaction buffer; any other consumer
+        # resolves through the fused compaction program exactly as
+        # before (see _resolve_fpending).
         base, funcs = aligned._chain_parts()
-
-        def build():
-            def fused(data):
-                mapped = _chain_apply(funcs, split, data)
-                flat = mapped.reshape((n,) + vshape)
-                mask = jax.vmap(
-                    lambda v: jnp.asarray(func(v), dtype=bool).reshape(()))(flat)
-                # survivor indices in increasing (key) order, padded with 0s
-                # beyond the count — rows past the count are garbage and are
-                # sliced away at resolution
-                perm = jnp.nonzero(mask, size=n, fill_value=0)[0]
-                padded = jnp.take(flat, perm, axis=0)
-                return (_constrain(padded, mesh, 1),
-                        jnp.sum(mask, dtype=jnp.int32))
-            return jax.jit(fused)
-
-        fn = _cached_jit(("filter-fused", func, funcs, base.shape,
-                          str(base.dtype), split, mesh), build)
-        padded, cnt = fn(_check_live(base))
         out = BoltArrayTPU(None, 1, mesh)
-        out._pending = (padded, cnt)
+        out._fpending = (base, funcs, func, split, vshape, n,
+                         np.dtype(aligned._aval.dtype))
         return out
 
     def _filter_eager(self, func, aligned, split, vshape, n, mesh):
@@ -777,6 +881,13 @@ class BoltArrayTPU(BoltArray):
         input fuses into the same program (map→reduce reads HBM once).
         """
         func = _traceable(func)
+        if self._fpending is not None:
+            # deferred filter feeding the reduce: fold the predicate into
+            # the pairwise tree — one fused HBM pass (see
+            # _fused_filter_reduce; NotImplemented geometries resolve)
+            out = self._fused_filter_reduce(func, axis, keepdims)
+            if out is not NotImplemented:
+                return out
         axes = sorted(tupleize(axis))
         aligned = self._align(axes)
         split = aligned._split
@@ -804,6 +915,10 @@ class BoltArrayTPU(BoltArray):
                 key_sharding(mesh, out.shape, new_split))
             return self._wrap(data, new_split)
 
+        # donation-aware terminal: consuming a sole-owned deferred chain
+        # frees the parent buffer inside the reduction program (checked
+        # BEFORE binding the base local — see _chain_donate_ok)
+        donate = aligned.deferred and _chain_donate_ok(aligned._chain)
         base, funcs = aligned._chain_parts()
 
         def build():
@@ -824,11 +939,14 @@ class BoltArrayTPU(BoltArray):
                 if keepdims:
                     out = out.reshape((1,) * split + vshape)
                 return _constrain(out, mesh, new_split)
-            return jax.jit(reducer)
+            return jax.jit(reducer, donate_argnums=(0,) if donate else ())
 
         fn = _cached_jit(("reduce", func, funcs, base.shape, str(base.dtype),
-                          split, keepdims, mesh), build)
-        return self._wrap(fn(_check_live(base)), new_split)
+                          split, keepdims, donate, mesh), build)
+        out = self._wrap(fn(_check_live(base)), new_split)
+        if donate:
+            aligned._consume_donated()
+        return out
 
     # ------------------------------------------------------------------
     # statistics (reference: ``BoltArraySpark._stat/stats`` + StatCounter
@@ -837,6 +955,14 @@ class BoltArrayTPU(BoltArray):
     # ------------------------------------------------------------------
 
     def _stat(self, axis, name, keepdims=False, ddof=None):
+        if self._fpending is not None:
+            # an unmaterialised filter feeding a reduction: fold the
+            # predicate mask straight into the reduce — ONE fused HBM
+            # pass, no compaction buffer (falls through to the resolving
+            # path for geometries the fused program does not serve)
+            out = self._fused_filter_stat(axis, name, keepdims, ddof)
+            if out is not NotImplemented:
+                return out
         if axis is None:
             axes = tuple(range(self._split)) if self._split else tuple(range(self.ndim))
         else:
@@ -847,6 +973,9 @@ class BoltArrayTPU(BoltArray):
         nkeys_reduced = sum(1 for a in axes if a < split)
         new_split = split if keepdims else split - nkeys_reduced
 
+        # donation-aware terminal (see _chain_donate_ok: checked before
+        # the base local exists)
+        donate = self.deferred and _chain_donate_ok(self._chain)
         base, funcs = self._chain_parts()
 
         def build():
@@ -860,11 +989,220 @@ class BoltArrayTPU(BoltArray):
                 mapped = _chain_apply(funcs, split, data)
                 out = op(mapped, axis=axes, keepdims=keepdims, **kwargs)
                 return _constrain(out, mesh, new_split)
-            return jax.jit(stat)
+            return jax.jit(stat, donate_argnums=(0,) if donate else ())
 
         fn = _cached_jit(("stat", name, funcs, base.shape, str(base.dtype),
-                          split, axes, keepdims, ddof, mesh), build)
-        return self._wrap(fn(_check_live(base)), new_split)
+                          split, axes, keepdims, ddof, donate, mesh), build)
+        out = self._wrap(fn(_check_live(base)), new_split)
+        if donate:
+            self._consume_donated()
+        return out
+
+    # identity each fusable reduction folds non-surviving records onto:
+    # where(mask, v, identity) makes dropped rows (NaNs included) inert,
+    # collapsing filter→reduce to ONE pass over the input
+    _FUSED_STAT_NAMES = ("sum", "prod", "any", "all", "mean", "var",
+                         "std", "max", "min")
+
+    def _fused_filter_stat(self, axis, name, keepdims, ddof):
+        """Single-pass ``filter(...).sum()``-family terminal: the
+        predicate mask folds into the reduction combine, so the 3-pass
+        mask+count+compact pipeline (and its input-sized compaction
+        buffer) never runs.  Returns NotImplemented for geometries the
+        fused program does not serve (the caller resolves and takes the
+        materialising path):
+
+        * reductions that keep the (dynamic) key axis — the output shape
+          would need the survivor count;
+        * ``ptp`` (needs both extrema identities at once) and
+          complex-var/std (resolve instead of reimplementing numpy's
+          abs²-moment rules);
+        * ``max``/``min`` ARE fused but sync the survivor count (one
+          scalar fetch, same price the eager path pays) to preserve the
+          zero-size reduction error.
+
+        ``mean``/``var``/``std`` divide by the masked COUNT (computed in
+        the same pass); var uses the one-pass moment form
+        ``(Σx² − (Σx)²/n)/(n−ddof)`` — single HBM read, documented as
+        slightly less cancellation-robust than the two-pass eager form."""
+        vshape = self._fpending[4]
+        ndim = 1 + len(vshape)
+        if axis is None:
+            axes = (0,)                      # the flat key axis (split=1)
+        else:
+            axes = tuple(sorted(tupleize(axis)))
+            for a in axes:
+                if not 0 <= a < ndim:
+                    return NotImplemented    # let the eager path reject
+        if 0 not in axes or name not in self._FUSED_STAT_NAMES:
+            return NotImplemented
+        vdtype = np.dtype(self._fpending[6])
+        if name in ("var", "std") and np.issubdtype(vdtype,
+                                                    np.complexfloating):
+            return NotImplemented
+        donate = _chain_donate_ok(self._fpending)    # [0] is the base
+        base, funcs, pred, psplit, vshape, n, _ = self._fpending
+        mesh = self._mesh
+        new_split = 1 if keepdims else 0
+        needs_count = name in ("max", "min")
+        # element count each output slot divides by beyond the mask: the
+        # reduced VALUE axes are dense (the mask only thins records)
+        prodv = prod([vshape[a - 1] for a in axes if a > 0])
+
+        def build():
+            op = {"sum": jnp.sum, "prod": jnp.prod, "any": jnp.any,
+                  "all": jnp.all, "max": jnp.max, "min": jnp.min}.get(name)
+            ref = {"mean": jnp.mean, "var": jnp.var, "std": jnp.std}.get(
+                name, op)
+            # output dtype from jnp's own promotion rule on a 1-record
+            # probe, so fused and eager results always agree on dtype
+            out_dt = jax.eval_shape(
+                lambda x: ref(x, axis=axes), jax.ShapeDtypeStruct(
+                    (1,) + tuple(vshape), vdtype)).dtype
+            if name in ("sum", "prod", "any", "all"):
+                ident = {"sum": 0, "prod": 1, "any": False,
+                         "all": True}[name]
+            elif name in ("max", "min"):
+                if np.issubdtype(vdtype, np.floating) or \
+                        np.issubdtype(vdtype, np.complexfloating):
+                    ident = -np.inf if name == "max" else np.inf
+                elif vdtype == np.bool_:
+                    ident = name == "min"
+                else:
+                    info = np.iinfo(vdtype)
+                    ident = info.min if name == "max" else info.max
+
+            def stat(data):
+                mapped = _chain_apply(funcs, psplit, data)
+                flat = mapped.reshape((n,) + tuple(vshape))
+                mask = jax.vmap(lambda v: jnp.asarray(
+                    pred(v), dtype=bool).reshape(()))(flat)
+                mfull = mask.reshape((n,) + (1,) * len(vshape))
+                cnt = jnp.sum(mask, dtype=jnp.int32)
+                if name in ("sum", "prod", "any", "all", "max", "min"):
+                    v = jnp.where(mfull, flat, jnp.asarray(ident,
+                                                           flat.dtype))
+                    out = op(v, axis=axes, keepdims=keepdims)
+                    if out.dtype != out_dt:
+                        out = out.astype(out_dt)
+                else:
+                    den = (cnt * prodv).astype(out_dt)
+                    xf = jnp.where(mfull, flat,
+                                   jnp.zeros((), flat.dtype)).astype(out_dt)
+                    s1 = jnp.sum(xf, axis=axes, keepdims=keepdims)
+                    if name == "mean":
+                        out = s1 / den
+                    else:
+                        dd = 0.0 if ddof is None else ddof
+                        s2 = jnp.sum(xf * xf, axis=axes, keepdims=keepdims)
+                        out = (s2 - s1 * s1 / den) / (den - dd)
+                        if name == "std":
+                            out = jnp.sqrt(out)
+                out = _constrain(out, mesh, new_split)
+                return (out, cnt) if needs_count else out
+            return jax.jit(stat, donate_argnums=(0,) if donate else ())
+
+        fn = _cached_jit(("filter-stat", name, pred, funcs, base.shape,
+                          str(base.dtype), psplit, axes, keepdims, ddof,
+                          donate, mesh), build)
+        out = fn(_check_live(base))
+        if donate:
+            # mark consumption BEFORE any error path below: the program
+            # already took the buffer, and a zero-survivor raise must
+            # leave this array guarded, not pointing at a deleted base
+            self._consume_donated()
+        if needs_count:
+            out, cnt = out
+            if int(jax.device_get(cnt)) == 0:
+                # match the eager path's zero-size reduction rejection
+                raise ValueError(
+                    "zero-size array to reduction operation %s which has "
+                    "no identity" % name)
+        return self._wrap(out, new_split)
+
+    def _fused_filter_reduce(self, func, axis, keepdims):
+        """Single-pass ``filter(...).reduce(func)``: the pairwise tree
+        carries a VALIDITY bit per slot — combining a valid with an
+        invalid slot selects the valid operand unchanged (no identity
+        element needed for arbitrary ``func``; garbage from combining
+        dropped records, NaNs included, is discarded by the select).  One
+        scalar sync of the survivor count afterwards preserves the
+        empty-reduce error contract.  NotImplemented (→ resolve-and-
+        materialise path) off the flat key axis or for non-traceable
+        reducers."""
+        axes = tuple(sorted(tupleize(axis)))
+        if axes != (0,):
+            return NotImplemented
+        donate = _chain_donate_ok(self._fpending)    # [0] is the base
+        base, funcs, pred, psplit, vshape, n, vdtype = self._fpending
+        if n == 0:
+            raise TypeError("reduce of an empty array with no initial value")
+        vaval = jax.ShapeDtypeStruct(tuple(vshape), vdtype)
+        try:
+            _cached_eval_shape(
+                ("reduce", func, tuple(vshape), str(vdtype)),
+                lambda: jax.eval_shape(func, vaval, vaval))
+        except _TRACE_ERRORS:
+            return NotImplemented            # host fallback path resolves
+        mesh = self._mesh
+        new_split = 1 if keepdims else 0
+
+        def build():
+            def reducer(data):
+                mapped = _chain_apply(funcs, psplit, data)
+                flat = mapped.reshape((n,) + tuple(vshape))
+                mask = jax.vmap(lambda v: jnp.asarray(
+                    pred(v), dtype=bool).reshape(()))(flat)
+                cnt = jnp.sum(mask, dtype=jnp.int32)
+                vfunc = jax.vmap(func)
+
+                def bc(m, like):
+                    return m.reshape(m.shape + (1,) * (like.ndim - 1))
+
+                x, valid = flat, mask
+                while x.shape[0] > 1:
+                    half = x.shape[0] // 2
+                    a, b = x[:half], x[half:2 * half]
+                    va, vb = valid[:half], valid[half:2 * half]
+                    comb = vfunc(a, b)
+                    if comb.shape != a.shape:
+                        raise ValueError(
+                            "reduce produced shape %s, expected value "
+                            "shape %s" % (comb.shape[1:], tuple(vshape)))
+                    # both valid → combined; one valid → that operand
+                    # (combined may be garbage and is discarded)
+                    sel = jnp.where(bc(va & vb, comb), comb,
+                                    jnp.where(bc(va, comb), a, b))
+                    vsel = va | vb
+                    rem, vrem = x[2 * half:], valid[2 * half:]
+                    if rem.shape[0]:
+                        x = jnp.concatenate([sel, rem], axis=0)
+                        valid = jnp.concatenate([vsel, vrem], axis=0)
+                    else:
+                        x, valid = sel, vsel
+                out = x[0]
+                if out.shape != tuple(vshape):
+                    raise ValueError(
+                        "reduce produced shape %s, expected value shape %s"
+                        % (out.shape, tuple(vshape)))
+                if keepdims:
+                    out = out.reshape((1,) + tuple(vshape))
+                return _constrain(out, mesh, new_split), cnt
+            return jax.jit(reducer, donate_argnums=(0,) if donate else ())
+
+        fn = _cached_jit(("filter-reduce", func, pred, funcs, base.shape,
+                          str(base.dtype), psplit, keepdims, donate, mesh),
+                         build)
+        out, cnt = fn(_check_live(base))
+        if donate:
+            # before the zero-survivor raise: the buffer is already gone,
+            # so the array must carry the guard, not the deleted base
+            self._consume_donated()
+        if int(jax.device_get(cnt)) == 0:
+            # every record was filtered out: same contract as reducing an
+            # (0, ...)-shaped resolved result
+            raise TypeError("reduce of an empty array with no initial value")
+        return self._wrap(out, new_split)
 
     def mean(self, axis=None, keepdims=False):
         """Mean over ``axis`` (default: all key axes)."""
@@ -1477,7 +1815,7 @@ class BoltArrayTPU(BoltArray):
         otherwise (contracted or displaced by broadcasting) the result is
         re-keyed to ``split=0``.  ``precision=None`` resolves through the
         scoped policy (``bolt.precision``), pinned at "highest"."""
-        from bolt_tpu.precision import resolve
+        from bolt_tpu._precision import resolve
         precision = resolve(precision)
         if isinstance(other, BoltArrayTPU):
             self._check_mesh(other, op.__name__)
@@ -2470,6 +2808,8 @@ class BoltArrayTPU(BoltArray):
         resolves the device side for free.  Large padded buffers skip the
         fast path — when few records survive, shipping the full buffer
         would cost more than the extra count round-trip saves."""
+        if self._fpending is not None:
+            self._resolve_fpending()   # one fused pass → (padded, count)
         if self._pending is not None:
             padded, cnt = self._pending
             if (padded.is_fully_addressable
@@ -2631,6 +2971,7 @@ class BoltArrayTPU(BoltArray):
         b = BoltArrayTPU(self._concrete, self._split, self._mesh)
         b._chain = self._chain
         b._pending = self._pending
+        b._fpending = self._fpending
         b._donated = self._donated
         b._aval = self._aval
         return b
@@ -2746,7 +3087,11 @@ class BoltArrayTPU(BoltArray):
     def __repr__(self):
         s = "BoltArray\n"
         s += "mode: %s\n" % self.mode
-        if self._pending is not None:
+        if self._fpending is not None:
+            # don't dispatch the filter just to print; show what is known
+            s += "shape: (%s)\n" % ", ".join(
+                ["?"] + [str(d) for d in self._fpending[4]])
+        elif self._pending is not None:
             # don't force the count sync just to print; show what is known
             s += "shape: (%s)\n" % ", ".join(
                 ["?"] + [str(d) for d in self._pending[0].shape[1:]])
@@ -2755,9 +3100,11 @@ class BoltArrayTPU(BoltArray):
         s += "split: %d\n" % self._split
         s += "dtype: %s\n" % str(self.dtype)
         if self._donated:
-            s += "donated: buffer consumed by swap(donate=True)\n"
+            s += "donated: buffer consumed by a donating swap or terminal\n"
         elif self.deferred:
             s += "deferred: %d-op map chain\n" % len(self._chain[1])
+        elif self._fpending is not None:
+            s += "pending: deferred filter (predicate not yet dispatched)\n"
         elif self._pending is not None:
             s += "pending: filter count not yet synced\n"
         else:

@@ -26,8 +26,9 @@ from jax.sharding import NamedSharding
 
 from bolt_tpu.parallel.sharding import combined_spec
 from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
-                                _canon, _chain_apply, _check_live,
-                                _check_value_shape, _constrain, _traceable)
+                                _canon, _chain_apply, _chain_donate_ok,
+                                _check_live, _check_value_shape, _constrain,
+                                _traceable)
 from bolt_tpu.utils import (chunk_align, chunk_pad, chunk_plan, iterexpand,
                             tupleize)
 
@@ -231,7 +232,11 @@ class ChunkedArray:
         vshard = dict(self._vshard)
         vs_key = tuple(sorted(vshard.items()))
         # a deferred chain on the underlying array fuses INTO the chunked
-        # program — no materialised intermediate between map and chunk.map
+        # program — no materialised intermediate between map and chunk.map;
+        # a sole-owned chain base additionally DONATES its buffer to the
+        # program (the chunked output is input-sized, so XLA aliases the
+        # two — the chunk→map→unchunk pipeline's donation-aware terminal)
+        donate = b.deferred and _chain_donate_ok(b._chain)
         base, funcs = b._chain_parts()
         canon = None if dtype is None else _canon(dtype)
 
@@ -296,12 +301,14 @@ class ChunkedArray:
                     if canon is not None:
                         out = out.astype(canon)
                     return _constrain_chunked(out, mesh, split, vshard)
-                return jax.jit(run)
+                return jax.jit(run, donate_argnums=(0,) if donate else ())
 
             fn = _cached_jit(("chunk-map-u", func, funcs, base.shape,
                               str(base.dtype), split, plan, vs_key, canon,
-                              mesh), build)
+                              donate, mesh), build)
             out = fn(_check_live(base))
+            if donate:
+                b._consume_donated()
             new_plan = tuple(o // g for o, g in zip(out.shape[split:], grid))
             return ChunkedArray(BoltArrayTPU(out, split, mesh), new_plan, pad,
                                 vshard)
@@ -372,12 +379,14 @@ class ChunkedArray:
                 if canon is not None:
                     out = out.astype(canon)
                 return _constrain_chunked(out, mesh, split, vshard)
-            return jax.jit(run)
+            return jax.jit(run, donate_argnums=(0,) if donate else ())
 
         fn = _cached_jit(("chunk-map-g", func, funcs, base.shape,
                           str(base.dtype), split, plan, pad, vs_key, canon,
-                          mesh), build)
+                          donate, mesh), build)
         out = fn(_check_live(base))
+        if donate:
+            b._consume_donated()
         return ChunkedArray(BoltArrayTPU(out, split, mesh), plan, pad, vshard)
 
     # ------------------------------------------------------------------

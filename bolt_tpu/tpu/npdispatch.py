@@ -1169,7 +1169,7 @@ def _einsum(*operands, out=None, optimize=False, **kwargs):
         and outl[:split] == term[:split]
         and tuple(out_aval.shape[:split]) == tuple(anchor.shape[:split])
     ) else 0
-    from bolt_tpu.precision import resolve
+    from bolt_tpu._precision import resolve
     pr = resolve()
     return _device_fused(
         "einsum", ops, anchor, new_split,
@@ -1202,7 +1202,7 @@ def _tensordot(a, b, axes=2):
         if all(x >= a.split for x in pa) and \
                 tuple(out_aval.shape[:a.split]) == tuple(a.shape[:a.split]):
             new_split = a.split
-    from bolt_tpu.precision import resolve
+    from bolt_tpu._precision import resolve
     pr = resolve()
     return _device_fused(
         "tensordot", [a, b], anchor, new_split,
@@ -1225,7 +1225,7 @@ def _inner(a, b):
         cap = min(a.split, max(a.ndim - 1, 0))
         if tuple(out_aval.shape[:cap]) == tuple(a.shape[:cap]):
             new_split = cap
-    from bolt_tpu.precision import resolve
+    from bolt_tpu._precision import resolve
     pr = resolve()
     return _device_fused(
         "inner", [a, b], anchor, new_split,
@@ -2695,11 +2695,29 @@ def _linalg_multi_dot(arrays, *, out=None):
     first_rows_survive = _is_tpu(seq[0]) and np.ndim(seq[0]) == 2 \
         and out_ndim >= 1
     new_split = min(seq[0].split, 1) if first_rows_survive else 0
-    return _device_fused(
-        "multi_dot", seq, anchor, new_split,
-        lambda *ds: jnp.linalg.multi_dot(
+    # the scoped precision policy applies like every other matmul-class
+    # op (@/dot/einsum/tensordot/inner) — chained products must not fall
+    # back to the TPU bf16 default under the pinned-'highest' contract
+    from bolt_tpu._precision import resolve
+    pr = resolve()
+    # MXU matmuls need float operands; integer chains are computed in
+    # f32 (exact below 2**24) and cast back to the numpy result dtype
+    # instead of leaking float32 where the oracle returns ints
+    dtypes = [_aval_of(o).dtype for o in seq]
+    rt = np.result_type(*dtypes)
+    int_out = np.issubdtype(rt, np.integer)
+    from bolt_tpu.tpu.array import _canon
+    target = _canon(rt) if int_out else None
+
+    def body(*ds):
+        out = jnp.linalg.multi_dot(
             [d.astype(jnp.promote_types(d.dtype, jnp.float32))
-             for d in ds]), ())
+             for d in ds], precision=pr)
+        if target is not None:
+            out = jnp.rint(out).astype(target)
+        return out
+    return _device_fused("multi_dot", seq, anchor, new_split, body,
+                         (pr, str(target)))
 
 
 @_implements(np.linalg.tensorsolve)
@@ -2708,12 +2726,29 @@ def _linalg_tensorsolve(a, b, axes=None):
     anchor = a if _is_tpu(a) else b
     _require_tpu(anchor)
     axs = None if axes is None else tuple(operator.index(x) for x in axes)
-    return _device_fused(
-        "tensorsolve", [a, b], anchor, 0,
-        lambda da, db: jnp.linalg.tensorsolve(
+    # numpy's solve promotes through common_type: ints → float64, floats
+    # keep their width — cast the f32-computed result to that target so
+    # integer inputs don't silently return float32 where the oracle
+    # answers (canonicalised) float64
+    from bolt_tpu.tpu.array import _canon
+
+    def _probe(x):
+        dt = np.dtype(_aval_of(x).dtype)
+        # common_type rejects non-numeric (bool) arrays; numpy's own
+        # tensorsolve promotes bools like ints → float64
+        return np.empty(0, np.int64 if dt == np.bool_ else dt)
+
+    rt = np.common_type(_probe(a), _probe(b))
+    target = _canon(rt)
+
+    def body(da, db):
+        out = jnp.linalg.tensorsolve(
             da.astype(jnp.promote_types(da.dtype, jnp.float32)),
             db.astype(jnp.promote_types(db.dtype, jnp.float32)),
-            axes=axs), (axs,))
+            axes=axs)
+        return out if out.dtype == target else out.astype(target)
+    return _device_fused("tensorsolve", [a, b], anchor, 0, body,
+                         (axs, str(target)))
 
 
 @_implements(np.linalg.tensorinv)

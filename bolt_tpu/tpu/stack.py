@@ -17,8 +17,9 @@ import jax
 import jax.numpy as jnp
 
 from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
-                                _canon, _chain_apply, _check_live,
-                                _check_value_shape, _constrain, _traceable)
+                                _canon, _chain_apply, _chain_donate_ok,
+                                _check_live, _check_value_shape, _constrain,
+                                _traceable)
 from bolt_tpu.utils import prod
 
 
@@ -77,6 +78,9 @@ class StackedArray:
         vshape = b.shape[split:]
         n = prod(kshape)
         size = self._size
+        # donation-aware terminal: a sole-owned deferred chain donates its
+        # base into the block-batched program (input-sized output)
+        donate = b.deferred and _chain_donate_ok(b._chain)
         base, funcs = b._chain_parts()
         canon = None if dtype is None else _canon(dtype)
         if value_shape is not None:
@@ -132,11 +136,14 @@ class StackedArray:
                 if canon is not None:
                     out = out.astype(canon)   # fused into the same program
                 return _constrain(out, mesh, split)
-            return jax.jit(run)
+            return jax.jit(run, donate_argnums=(0,) if donate else ())
 
         fn = _cached_jit(("stack-map", func, funcs, base.shape,
-                          str(base.dtype), split, size, canon, mesh), build)
+                          str(base.dtype), split, size, canon, donate,
+                          mesh), build)
         out = fn(_check_live(base))
+        if donate:
+            b._consume_donated()
         return StackedArray(BoltArrayTPU(out, split, mesh), size)
 
     def unstack(self):

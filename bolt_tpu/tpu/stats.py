@@ -119,7 +119,8 @@ def welford(barray, requested=("mean", "var", "std", "min", "max"),
         # annotation, and every cross-device combine here is an explicit
         # psum/pmax/pmin — there is nothing for the varying-axes checker
         # to catch on this function
-        return jax.jit(jax.shard_map(
+        from bolt_tpu._compat import shard_map
+        return jax.jit(shard_map(
             local_moments, mesh=mesh, in_specs=P(*spec),
             out_specs=(out_spec, out_spec, out_spec, out_spec),
             check_vma=False))

@@ -244,17 +244,32 @@ def main():
     bt = lcg_tpu(shape4, salt=4).cache()
     lo_arr, lt = timed(lambda: x[x.mean(axis=(1, 2)) > 0], iters=2)
 
-    # filter dispatches async (lazy-count pending result); the closing
-    # sync resolves the last iteration's count + probe.  keep_all=False:
+    # filter() now DEFERS (reduction terminals fuse the predicate);
+    # materialising configs must dispatch the compaction program
+    # explicitly so every pipelined iteration runs.  keep_all=False:
     # at 24 iterations the pending results' padded buffers (0.94 GB
     # each) must retire as the loop runs, not accumulate
-    to, tt = timed_tpu(lambda: bt.filter(MEANPOS), iters=24,
-                       keep_all=False)
+    def launch4():
+        out = bt.filter(MEANPOS)
+        out._resolve_fpending()     # async dispatch, count stays on device
+        return out
+
+    to, tt = timed_tpu(launch4, iters=24, keep_all=False)
     # ~0.5 GB of survivors: parity on count + sampled survivor rows
     ok = (to.shape == lo_arr.shape
           and allclose(lo_arr[:2], fetch(to, np.s_[:2]))
           and allclose(lo_arr[-1], fetch(to, np.s_[-1])))
     rows.append(_progress("4 filter mask 0.94GB", lt, tt, "exact*" if ok else "MISMATCH"))
+
+    # ---- config 4b: fused filter→sum terminal (ISSUE 1) --------------
+    # the predicate folds into the reduction combine: ONE pass over the
+    # input, no compaction buffer — vs config 4's ~3 passes
+    lo_sum, lt4b = timed(lambda: x[x.mean(axis=(1, 2)) > 0].sum(axis=0),
+                         iters=2)
+    to4b, tt4b = timed_tpu(lambda: bt.filter(MEANPOS).sum(), iters=24)
+    ok4b = allclose(lo_sum, fetch(to4b, np.s_[:]), rtol=1e-4)
+    rows.append(_progress("4b filter->sum fused 0.94GB", lt4b, tt4b,
+                          "close*" if ok4b else "MISMATCH"))
     del x, lo_arr
 
     # ---- config 5: per-chunk SVD (tall-skinny PCA) -------------------

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 import bolt_tpu as bolt
+from bolt_tpu._compat import shard_map as _shard_map
 
 
 def _hlo_of_cached(kind, arg):
@@ -62,7 +63,7 @@ def test_halo_exchange_lowers_to_collective_permute(mesh):
 
     x = jnp.asarray(np.random.RandomState(3).randn(16, 4))
     sh = jax.device_put(x, NamedSharding(mesh, P("k")))
-    f = jax.shard_map(lambda d: exchange_halo(d, axis=0, pad=1, axis_name="k"),
+    f = _shard_map(lambda d: exchange_halo(d, axis=0, pad=1, axis_name="k"),
                       mesh=mesh, in_specs=P("k"), out_specs=P("k"))
     txt = jax.jit(f).lower(sh).compile().as_text()
     assert "collective-permute" in txt

@@ -11,6 +11,8 @@ them to both representations, and asserts `allclose` parity at the end."""
 import os
 
 import numpy as np
+import pytest
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 import bolt_tpu as bolt

@@ -15,7 +15,7 @@ import numpy as np
 import pytest
 
 import bolt_tpu as bolt
-from bolt_tpu.precision import MODES, precision, resolve
+from bolt_tpu._precision import MODES, precision, resolve
 
 
 def test_resolution_order():
@@ -147,3 +147,85 @@ def test_default_unchanged_outside_scope(mesh):
         (b @ w).toarray()
     assert len([k for k in _JIT_CACHE
                 if k and k[0] == "matmul"]) == n0
+
+
+def test_resolve_accepts_jax_precision_enums():
+    """The 0.4.0 dot(..., precision=...) contract took any jax precision
+    spelling: lax.Precision members (and case-insensitive mode names)
+    must map onto the three mode strings (ADVICE r5)."""
+    from jax import lax
+    assert resolve(lax.Precision.DEFAULT) == "default"
+    assert resolve(lax.Precision.HIGH) == "high"
+    assert resolve(lax.Precision.HIGHEST) == "highest"
+    assert resolve("HIGHEST") == "highest"
+    with precision(lax.Precision.DEFAULT):
+        assert resolve() == "default"
+
+
+def test_dot_accepts_jax_precision_enum(mesh):
+    from jax import lax
+    rs = np.random.RandomState(31)
+    x, w = rs.randn(8, 6), rs.randn(6, 4)
+    b = bolt.array(x, mesh)
+    out = b.dot(w, precision=lax.Precision.HIGHEST)
+    assert np.allclose(np.asarray(out.toarray()), x @ w)
+
+
+def test_multi_dot_honours_precision_scope(mesh):
+    """multi_dot resolves the scoped policy like every other matmul-class
+    op: distinct modes produce DISTINCT executables (the precision rides
+    the cache key), same mode reuses one (ADVICE r5 medium)."""
+    from bolt_tpu.tpu.array import _JIT_CACHE
+    rs = np.random.RandomState(32)
+    b = bolt.array(rs.randn(8, 6), mesh)
+    mats = [rs.randn(6, 5), rs.randn(5, 4)]
+    ref = np.linalg.multi_dot([np.asarray(b.toarray())] + mats)
+    out = np.linalg.multi_dot([b] + mats)
+    assert np.allclose(np.asarray(out.toarray()), ref)
+    n0 = len([k for k in _JIT_CACHE if k and k[0] == "multi_dot"])
+    with precision("default"):
+        np.linalg.multi_dot([b] + mats)
+    n1 = len([k for k in _JIT_CACHE if k and k[0] == "multi_dot"])
+    assert n1 == n0 + 1
+    with precision("default"):
+        np.linalg.multi_dot([b] + mats)
+    assert len([k for k in _JIT_CACHE
+                if k and k[0] == "multi_dot"]) == n1
+
+
+def test_multi_dot_integer_dtype_matches_oracle(mesh):
+    """Integer chains must come back as (canonicalised) ints, not leak
+    the f32 compute dtype (ADVICE r5 low)."""
+    rs = np.random.RandomState(33)
+    a = rs.randint(-4, 5, (6, 5))
+    m1, m2 = rs.randint(-4, 5, (5, 4)), rs.randint(-4, 5, (4, 3))
+    b = bolt.array(a, mesh)
+    out = np.linalg.multi_dot([b, m1, m2])
+    ref = np.linalg.multi_dot([a, m1, m2])
+    assert np.issubdtype(out.dtype, np.integer)
+    assert np.array_equal(np.asarray(out.toarray()), ref)
+
+
+def test_tensorsolve_integer_dtype_matches_oracle(mesh):
+    """tensorsolve of ints answers in numpy's float solve dtype
+    (canonicalised), not a silent float32 (ADVICE r5 low)."""
+    rs = np.random.RandomState(34)
+    a = np.eye(6, dtype=np.int64) * 2
+    bvec = rs.randint(-3, 4, (6,))
+    bb = bolt.array(a, mesh)
+    out = np.linalg.tensorsolve(bb, bvec)
+    ref = np.linalg.tensorsolve(a, bvec)
+    assert out.dtype == ref.dtype
+    assert np.allclose(np.asarray(out.toarray()), ref)
+
+
+def test_precision_module_alias():
+    """bolt_tpu.precision (the attribute) is the context manager;
+    bolt_tpu._precision is the module; the legacy from-import keeps
+    working through the alias shim (ADVICE r5 low)."""
+    import bolt_tpu
+    import bolt_tpu._precision as mod
+    assert callable(bolt_tpu.precision)
+    assert bolt_tpu.precision is mod.precision
+    from bolt_tpu.precision import resolve as r2
+    assert r2 is mod.resolve

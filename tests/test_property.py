@@ -3,6 +3,8 @@ the TPU backend must always agree with the NumPy oracle.  Complements the
 reference's brute-force enumeration style with randomized coverage."""
 
 import numpy as np
+import pytest
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 import bolt_tpu as bolt

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 import bolt_tpu as bolt
+from bolt_tpu._compat import shard_map as _shard_map
 from bolt_tpu.parallel import combined_spec, exchange_halo
 from bolt_tpu.utils import allclose
 
@@ -85,7 +86,7 @@ def test_exchange_halo(mesh):
         # window sum over [i-1, i, i+1]
         return padded[:-2] + padded[1:-1] + padded[2:]
 
-    out = jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
+    out = jax.jit(_shard_map(kernel, mesh=mesh, in_specs=P("k"),
                                 out_specs=P("k")))(xg)
     padded_np = np.concatenate([[0.0], x, [0.0]])
     expected = padded_np[:-2] + padded_np[1:-1] + padded_np[2:]
@@ -101,7 +102,7 @@ def test_exchange_halo_wrap(mesh):
         padded = exchange_halo(local, 1, 0, "k", mode="wrap")
         return padded[:-2] + padded[1:-1] + padded[2:]
 
-    out = jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
+    out = jax.jit(_shard_map(kernel, mesh=mesh, in_specs=P("k"),
                                 out_specs=P("k")))(xg)
     padded_np = np.concatenate([[x[-1]], x, [x[0]]])
     expected = padded_np[:-2] + padded_np[1:-1] + padded_np[2:]
@@ -141,7 +142,7 @@ def test_halo_pad_exceeds_shard(mesh):
     def kernel(local):
         return exchange_halo(local, 5, 0, "k")  # shard extent is 2
     with pytest.raises(ValueError):
-        jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
+        jax.jit(_shard_map(kernel, mesh=mesh, in_specs=P("k"),
                               out_specs=P("k")))(jnp.ones(16))
 
 
@@ -149,7 +150,7 @@ def test_exchange_halo_validation(mesh):
     def kernel(local):
         return exchange_halo(local, 1, 0, "k", mode="bogus")
     with pytest.raises(ValueError):
-        jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("k"),
+        jax.jit(_shard_map(kernel, mesh=mesh, in_specs=P("k"),
                               out_specs=P("k")))(jnp.ones(16))
 
 
