@@ -28,8 +28,8 @@ __all__ = ["array", "ones", "zeros", "full", "rand", "randn",
            "BoltArray", "BoltArrayLocal", "BoltArrayTPU",
            "HostFallbackWarning", "__version__"]
 
-_SUBMODULES = ("checkpoint", "engine", "profile", "parallel", "ops",
-               "statcounter", "utils")
+_SUBMODULES = ("analysis", "checkpoint", "engine", "profile", "parallel",
+               "ops", "statcounter", "utils")
 
 
 def __getattr__(name):

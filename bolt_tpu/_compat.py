@@ -23,6 +23,11 @@ import jax
 
 HAS_AXIS_TYPES = hasattr(jax.sharding, "AxisType")
 
+# The version watershed the suites key xfails on: runtimes predating the
+# jax.shard_map promotion (0.4.x/0.5.x) also carry the GSPMD and
+# jnp.ufunc behavior gaps documented per-test.  True = OLD runtime.
+OLD_JAX = not hasattr(jax, "shard_map")
+
 
 def make_mesh(shape, axis_names):
     """An n-d mesh with Auto-typed axes on runtimes that type mesh axes

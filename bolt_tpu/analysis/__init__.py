@@ -1,0 +1,90 @@
+"""bolt_tpu.analysis — static analysis for deferred pipelines and the repo.
+
+Two halves:
+
+* **Abstract pipeline checker** (:func:`check` / :func:`explain`): walk a
+  ``BoltArrayTPU``'s deferred ``_chain``/``_pending``/``_fpending`` state
+  and abstractly interpret it with ``jax.eval_shape``-style tracing —
+  result shape, dtype and key sharding per stage, plus structured
+  ``BLT0xx`` diagnostics (shape failures, aval lies, dtype widening,
+  mesh-indivisible key splits, donation-safety violations) — with ZERO
+  XLA compiles (``engine.counters()`` stays flat apart from the
+  ``diagnostics`` tally the checker feeds).
+
+      rep = bolt_tpu.analysis.check(b.map(f).filter(p))
+      print(rep)                  # per-stage table + diagnostics
+      rep.shape, rep.dtype        # the prediction a terminal will realise
+
+* **Repo invariant linter** (:mod:`bolt_tpu.analysis.astlint`,
+  ``scripts/lint_bolt.py``): AST rules ``BLT1xx`` enforcing the engine /
+  ``_compat`` / ``_precision`` / donation-gate routing invariants;
+  zero findings on ``bolt_tpu/`` itself is a tier-1 test.
+
+:func:`strict` arms the engine's pre-dispatch gate: inside the scope,
+every dispatching terminal (chain materialisation, ``reduce``, the stat
+family, fused filters, ``chunk().map``, ``stacked().map``) first runs
+the checker and REFUSES to dispatch — raising :class:`PipelineError`
+before any compile — when error-severity findings exist::
+
+    with bolt_tpu.analysis.strict():
+        b.map(broken).sum()       # raises PipelineError, zero compiles
+"""
+
+import contextlib
+import threading
+
+from bolt_tpu import engine as _engine
+from bolt_tpu.analysis.diagnostics import (CODES, Diagnostic,
+                                           PipelineError, Report, Stage)
+from bolt_tpu.analysis.check import check, explain
+from bolt_tpu.analysis import astlint
+
+__all__ = ["check", "explain", "strict", "in_strict", "CODES",
+           "Diagnostic", "Report", "Stage", "PipelineError", "astlint"]
+
+_tls = threading.local()
+_ACTIVE = 0                       # strict scopes alive across ALL threads
+_ACTIVE_LOCK = threading.Lock()
+
+
+def in_strict():
+    """True while the calling thread is inside a :func:`strict` scope."""
+    return getattr(_tls, "depth", 0) > 0
+
+
+def _strict_dispatch_guard(arr, op):
+    """The engine's pre-dispatch gate (installed by :func:`strict`):
+    check the array about to dispatch ``op``; refuse — BEFORE any
+    compile — on error-severity findings.  Threads outside a strict
+    scope pass through untouched (the scope is thread-local)."""
+    if not in_strict():
+        return
+    _engine.strict_checked()
+    rep = check(arr)
+    if not rep.ok:
+        _engine.strict_rejected()
+        raise PipelineError(op, rep)
+
+
+@contextlib.contextmanager
+def strict():
+    """Scope making the engine run :func:`check` before every
+    dispatching terminal and refuse (``PipelineError``) on
+    error-severity findings.  Nests; thread-local (concurrent threads
+    outside the scope dispatch normally).  Engine counters account the
+    gate: ``strict_checks`` runs, ``strict_rejections`` refusals,
+    ``diagnostics`` findings."""
+    global _ACTIVE
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    with _ACTIVE_LOCK:
+        _ACTIVE += 1
+        if _ACTIVE == 1:
+            _engine.set_strict_guard(_strict_dispatch_guard)
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+        with _ACTIVE_LOCK:
+            _ACTIVE -= 1
+            if _ACTIVE == 0:
+                _engine.set_strict_guard(None)

@@ -1,0 +1,314 @@
+"""AST-based repo invariant linter: mechanical enforcement of the
+codebase rules that PR reviews kept re-litigating.
+
+Rules (the ``BLT1xx`` range; the abstract pipeline checker owns
+``BLT0xx`` — see :mod:`bolt_tpu.analysis.diagnostics`):
+
+* **BLT101** — no bare ``jax.jit`` outside ``engine.py``.  Every
+  compiled program must go through the central dispatch engine (AOT
+  compile cache, hit/miss/compile-time counters, persistent on-disk
+  cache); a ``jax.jit`` call is allowed only inside a *builder* —
+  a function or lambda passed to ``_cached_jit(key, builder)`` /
+  ``engine.get(key, builder)``, whose returned jitted callable the
+  engine owns.
+* **BLT102** — no version-sensitive jax API outside ``_compat.py``
+  (``jax.shard_map`` / ``jax.experimental.shard_map``,
+  ``jax.lax.axis_size``, ``jax.sharding.AxisType``, ``jax.make_mesh``).
+  The cross-version policy lives in ONE file; scattered ``hasattr``
+  probes are exactly what ``_compat`` exists to prevent.
+* **BLT103** — no ``precision=`` literals (a string constant or a
+  ``jax.lax.Precision`` member) at call sites outside ``_precision.py``.
+  Matmul-class precision must route through ``_precision.resolve()`` so
+  the scoped ``bolt.precision(...)`` policy — or a deliberate,
+  auditable ``resolve("highest")`` pin — always applies.  Function
+  *defaults* (``def f(..., precision="highest")``) are the documented
+  pinned defaults and are allowed.
+* **BLT104** — no ``._concrete`` access outside ``tpu/array.py``.
+  Reads must go through ``._data``, which runs the ``_guard_donated``
+  donation gate; a direct ``._concrete`` read can hand out a buffer a
+  donating terminal already consumed.
+
+A finding on line *N* is suppressed when that line carries a
+``# lint: allow(BLT1xx <reason>)`` pragma — the escape hatch for the
+documented exceptions (e.g. the module-level ``@jax.jit`` label-minmax
+program in ``ops/group.py``).
+
+This module imports ONLY the standard library, so
+``scripts/lint_bolt.py`` runs in milliseconds with no jax import.
+"""
+
+import ast
+import os
+
+RULES = {
+    "BLT101": "bare jax.jit outside the engine dispatch path",
+    "BLT102": "version-sensitive jax API outside bolt_tpu/_compat.py",
+    "BLT103": "precision= literal bypassing _precision.resolve()",
+    "BLT104": "._concrete access bypassing the _guard_donated gate",
+}
+
+# rule -> path suffixes (os-normalised) exempt from it
+_EXEMPT = {
+    "BLT101": ("engine.py",),
+    "BLT102": ("_compat.py",),
+    "BLT103": ("_precision.py",),
+    "BLT104": (os.path.join("tpu", "array.py"),),
+}
+
+_VERSION_SENSITIVE = {
+    "jax.shard_map",
+    "jax.experimental.shard_map",
+    "jax.lax.axis_size",
+    "jax.sharding.AxisType",
+    "jax.make_mesh",
+}
+
+# call names whose second argument is an engine builder
+_BUILDER_SINKS = {"_cached_jit"}
+_BUILDER_SINK_ATTRS = {"engine.get", "_engine.get"}
+
+
+class Finding:
+    """One linter finding: ``code``, ``path``, ``line``/``col`` and a
+    message (plus the rule's one-line title)."""
+
+    __slots__ = ("code", "path", "line", "col", "message")
+
+    def __init__(self, code, path, line, col, message):
+        self.code = code
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+
+    @property
+    def title(self):
+        return RULES[self.code]
+
+    def render(self):
+        return "%s:%d:%d: %s %s" % (self.path, self.line, self.col,
+                                    self.code, self.message)
+
+    def __repr__(self):
+        return "<Finding %s %s:%d>" % (self.code, self.path, self.line)
+
+
+def _dotted(node):
+    """``a.b.c`` attribute/name chain as a dotted string (or None)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _exempt(code, path):
+    norm = os.path.normpath(path)
+    return any(norm.endswith(suffix) for suffix in _EXEMPT[code])
+
+
+def _builder_regions(tree):
+    """Line spans of every function/lambda passed as the builder
+    argument to ``_cached_jit``/``engine.get`` — the only places a
+    ``jax.jit`` call is the engine's own, not a bypass.
+
+    Name builders (``def build(): ...`` then ``_cached_jit(key,
+    build)``) are resolved within the ENCLOSING function scope of the
+    sink call, not module-wide — a same-named local builder in an
+    unrelated function must not whitelist a direct-called jit there."""
+    spans = []
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def scope_of(node):
+        while node in parents:
+            node = parents[node]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.Module)):
+                return node
+        return tree
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        is_sink = (isinstance(fn, ast.Name) and fn.id in _BUILDER_SINKS) \
+            or (_dotted(fn) in _BUILDER_SINK_ATTRS)
+        if not is_sink or len(node.args) < 2:
+            continue
+        builder = node.args[1]
+        if isinstance(builder, ast.Lambda):
+            spans.append((builder.lineno, builder.end_lineno))
+        elif isinstance(builder, ast.Name):
+            for cand in ast.walk(scope_of(node)):
+                if isinstance(cand, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and cand.name == builder.id:
+                    spans.append((cand.lineno, cand.end_lineno))
+    return spans
+
+
+def _in_spans(line, spans):
+    return any(lo <= line <= hi for lo, hi in spans)
+
+
+def _pragma_lines(src):
+    """Line numbers carrying a ``lint: allow(CODE ...)`` pragma, mapped
+    to the allowed code."""
+    allowed = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        if "lint: allow(" not in line:
+            continue
+        frag = line.split("lint: allow(", 1)[1]
+        code = frag.split()[0].rstrip(")") if frag.split() else ""
+        allowed[i] = code
+    return allowed
+
+
+def lint_source(src, path="<string>"):
+    """Lint one module's source text; returns a list of
+    :class:`Finding` (sorted by line)."""
+    tree = ast.parse(src, filename=path)
+    pragmas = _pragma_lines(src)
+    findings = []
+
+    def emit(code, node, message):
+        line = getattr(node, "lineno", 0)
+        if _exempt(code, path):
+            return
+        if pragmas.get(line) == code:
+            return
+        findings.append(Finding(code, path, line,
+                                getattr(node, "col_offset", 0), message))
+
+    builder_spans = _builder_regions(tree)
+
+    # import aliases: local name -> dotted origin ("from jax import jit")
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.experimental.shard_map"):
+                    emit("BLT102", node,
+                         "import of jax.experimental.shard_map; route it "
+                         "through bolt_tpu._compat.shard_map")
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = "%s.%s" % (node.module,
+                                                         a.name)
+            # BLT102: importing the version-sensitive module itself
+            if node.module.startswith("jax.experimental.shard_map"):
+                emit("BLT102", node,
+                     "import of jax.experimental.shard_map; route it "
+                     "through bolt_tpu._compat.shard_map")
+            else:
+                for a in node.names:
+                    full = "%s.%s" % (node.module, a.name)
+                    if full in _VERSION_SENSITIVE:
+                        emit("BLT102", node,
+                             "import of %s; route it through "
+                             "bolt_tpu._compat" % full)
+
+    def resolved(node):
+        """Dotted chain with the leading import alias expanded."""
+        dotted = _dotted(node)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        origin = aliases.get(head)
+        if origin:
+            return origin + ("." + rest if rest else "")
+        return dotted
+
+    for node in ast.walk(tree):
+        # ---- BLT101: bare jax.jit --------------------------------------
+        jit_nodes = []
+        if isinstance(node, ast.Call) and resolved(node.func) == "jax.jit":
+            jit_nodes.append(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                # bare @jax.jit only — a @jax.jit(...) decorator is a
+                # Call and the branch above already sees it
+                if not isinstance(dec, ast.Call) \
+                        and resolved(dec) == "jax.jit":
+                    jit_nodes.append(dec)
+        for jn in jit_nodes:
+            if not _in_spans(jn.lineno, builder_spans):
+                emit("BLT101", jn,
+                     "bare jax.jit bypasses the engine's AOT compile "
+                     "cache; return it from a builder passed to "
+                     "_cached_jit/engine.get")
+
+        # ---- BLT102: version-sensitive attribute chains ----------------
+        if isinstance(node, ast.Attribute):
+            dotted = resolved(node)
+            if dotted in _VERSION_SENSITIVE:
+                emit("BLT102", node,
+                     "%s is version-sensitive; use the bolt_tpu._compat "
+                     "shim" % dotted)
+
+        # ---- BLT103: precision= literals -------------------------------
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg != "precision":
+                    continue
+                v = kw.value
+                literal = isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str)
+                if not literal:
+                    # alias-aware like BLT101/102: `from jax.lax import
+                    # Precision as P; precision=P.HIGHEST` must match
+                    vd = resolved(v) or ""
+                    literal = ".Precision." in "." + vd
+                if literal:
+                    emit("BLT103", kw.value,
+                         "precision literal at a call site bypasses the "
+                         "scoped policy; pass "
+                         "_precision.resolve(...) instead (use "
+                         "resolve('highest') for a deliberate pin)")
+
+        # ---- BLT104: ._concrete outside the donation gate --------------
+        if isinstance(node, ast.Attribute) and node.attr == "_concrete":
+            emit("BLT104", node,
+                 "._concrete bypasses the _guard_donated donation gate; "
+                 "read ._data instead")
+
+    findings.sort(key=lambda f: (f.line, f.col))
+    return findings
+
+
+def lint_file(path):
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def iter_py_files(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths):
+    findings = []
+    for p in paths:
+        if os.path.isdir(p):
+            for f in iter_py_files(p):
+                findings.extend(lint_file(f))
+        else:
+            findings.extend(lint_file(p))
+    return findings
+
+
+def lint_package(root=None):
+    """Lint the ``bolt_tpu`` package (zero findings is a tier-1
+    invariant — ``tests/test_static_analysis.py``)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return lint_paths([root])

@@ -1,0 +1,343 @@
+"""Abstract pipeline checker: interpret a deferred pipeline without
+compiling or dispatching anything.
+
+PR 1 made pipelines deferred, fused and donation-aware — a
+:class:`~bolt_tpu.tpu.array.BoltArrayTPU` can be an opaque
+``(base, funcs)`` program whose shape/dtype/sharding errors and
+use-after-donate crashes only surface at XLA compile or dispatch time.
+:func:`check` walks that recorded state — the ``_chain`` map chain, a
+deferred ``_fpending`` filter, a ``_pending`` compaction — and abstractly
+interprets it stage by stage with ``jax.eval_shape`` (abstract
+interpretation only: ZERO XLA compiles, proven by the engine counters
+staying flat), inferring the result shape, dtype and key sharding per
+stage and emitting structured ``BLT0xx`` diagnostics
+(:mod:`bolt_tpu.analysis.diagnostics`) for:
+
+* stages that fail abstract tracing (``BLT001``);
+* a recorded result aval that lies about what the chain produces
+  (``BLT002`` — the ``value_shape``-lie class);
+* silent dtype widening along the chain (``BLT003`` — an f32 pipeline
+  that materialises f64 doubles its HBM footprint);
+* key axes that do not divide the mesh, leaving devices idle
+  (``BLT004``);
+* donation-safety violations: any read path that hits a ``_donated``
+  buffer (``BLT005``), and a forecast of the terminal donation the
+  engine's policy WILL grant (``BLT006``);
+* a filter predicate that is not scalar-per-record (``BLT007``) and
+  dynamic shapes pending a survivor-count sync (``BLT008``).
+
+The interpretation applies each stage through the SAME
+``_chain_apply`` the compiled program uses, so predicted and executed
+shape/dtype cannot drift (``tests/test_pipeline_fuzz.py`` asserts this
+parity on every fuzzed pipeline).
+"""
+
+import numpy as np
+
+import jax
+
+from bolt_tpu.analysis.diagnostics import Diagnostic, Report, Stage
+from bolt_tpu.parallel.sharding import key_spec, spec_names
+from bolt_tpu.utils import prod
+
+
+def _name(func):
+    return getattr(func, "__name__", None) or type(func).__name__
+
+
+def _func_label(func):
+    from bolt_tpu.tpu.array import _WithKeysFunc
+    if isinstance(func, _WithKeysFunc):
+        return "map(%s, with_keys)" % _name(func.func)
+    return "map(%s)" % _name(func)
+
+
+def _stage_eval(func, split, aval):
+    """Abstractly apply ONE chain stage — through the same
+    ``_chain_apply`` the compiled program runs, so the prediction cannot
+    drift from execution.  Results are memoised in the array module's
+    eval cache (keyed on func identity + input aval)."""
+    from bolt_tpu.tpu.array import _cached_eval_shape, _chain_apply
+    key = ("analysis-stage", func, split, tuple(aval.shape),
+           str(aval.dtype))
+    return _cached_eval_shape(
+        key, lambda: jax.eval_shape(
+            lambda d: _chain_apply((func,), split, d),
+            jax.ShapeDtypeStruct(tuple(aval.shape), aval.dtype)))
+
+
+def _would_donate(arr):
+    """Would the NEXT terminal donate this array's chain base?  Mirrors
+    the terminals exactly by delegating to ``_chain_donate_ok`` with the
+    same reference pattern (attribute access straight into the call, no
+    extra locals — the ownership test is refcount-based)."""
+    from bolt_tpu.tpu.array import _chain_donate_ok
+    if arr._fpending is not None:
+        return _chain_donate_ok(arr._fpending)
+    if arr._chain is not None:
+        return _chain_donate_ok(arr._chain)
+    return False
+
+
+def _idle_device_check(mesh, shape, split, stage_idx, diags, seen):
+    """``BLT004`` once per report: the derived key sharding leaves mesh
+    devices idle because the key extents do not divide the mesh.
+    Malformed state (split beyond the rank — exactly what hand-built
+    deferred arrays can carry) must not crash the checker: the shape
+    contradiction gets its own BLT002, so sharding is simply skipped."""
+    if seen or mesh is None or not split:
+        return seen
+    try:
+        spec = key_spec(mesh, shape, split)
+        names = [n for e in spec for n in spec_names(e)]
+        assigned = prod([mesh.shape[n] for n in names]) if names else 1
+        full = prod([mesh.shape[n] for n in mesh.axis_names
+                     if mesh.shape[n] > 1])
+    except Exception:
+        return seen
+    if assigned < full:
+        diags.append(Diagnostic(
+            "BLT004", stage_idx,
+            "key axes %s assign only %d of %d mesh devices (extents do "
+            "not divide the mesh %s)"
+            % (tuple(shape[:split]), assigned, full, dict(mesh.shape)),
+            hint="reshape the key axes (keys.reshape) or choose key "
+                 "extents divisible by the mesh axis sizes"))
+        return True
+    return seen
+
+
+def _spec(mesh, shape, split):
+    try:
+        return key_spec(mesh, shape, split)
+    except Exception:
+        return None
+
+
+def check(obj):
+    """Abstractly interpret ``obj``'s recorded pipeline; returns a
+    :class:`~bolt_tpu.analysis.diagnostics.Report`.
+
+    Accepts a ``BoltArrayTPU``, a ``ChunkedArray``/``StackedArray`` view
+    (checked through its underlying array), or a local array (trivial
+    report).  Never compiles, dispatches, syncs a survivor count or
+    resolves deferred state — ``engine.counters()`` is unchanged except
+    for the ``diagnostics`` tally this check feeds."""
+    from bolt_tpu import engine
+    from bolt_tpu.tpu.array import BoltArrayTPU
+
+    target = "tpu"
+    arr = obj
+    # unwrap the thin views — their pipeline state IS the wrapped array's
+    from bolt_tpu.tpu.chunk import ChunkedArray
+    from bolt_tpu.tpu.stack import StackedArray
+    if isinstance(arr, ChunkedArray):
+        target = "tpu, chunked view plan=%s" % (arr.plan,)
+        arr = arr._barray
+    elif isinstance(arr, StackedArray):
+        target = "tpu, stacked view size=%d" % arr.size
+        arr = arr._barray
+
+    if not isinstance(arr, BoltArrayTPU):
+        # local oracle (or anything array-like): nothing deferred to check
+        shape = tuple(np.shape(np.asarray(arr))) \
+            if not hasattr(arr, "shape") else tuple(arr.shape)
+        dtype = np.dtype(getattr(arr, "dtype", np.asarray(arr).dtype))
+        rep = Report("local", [Stage(0, "base", shape, dtype,
+                                     getattr(arr, "split", 0) or 0)], [])
+        return rep
+
+    diags = []
+    stages = []
+
+    if arr._donated:
+        op = arr._donated if isinstance(arr._donated, str) \
+            else "a donating terminal"
+        diags.append(Diagnostic(
+            "BLT005", -1,
+            "this array's device buffer was donated to %s; every read "
+            "path (toarray, reduce, map, ...) will raise" % op,
+            hint="re-materialise from the source array, or disable the "
+                 "policy with engine.donation(None) before the "
+                 "consuming terminal"))
+        rep = Report(target, stages, diags)
+        engine.record_diagnostics(len(diags))
+        return rep
+
+    # donation forecast BEFORE binding any base/chain local (the
+    # ownership test is refcount-based; an extra local would mask it)
+    will_donate = _would_donate(arr)
+
+    mesh = arr._mesh
+    fp = arr._fpending
+    pend = arr._pending
+    idle_seen = False
+    dynamic = False
+
+    if fp is not None:
+        base, funcs, pred, walk_split, vshape, n, vdtype = fp
+    elif arr._chain is not None:
+        base, funcs = arr._chain
+        walk_split = arr._split
+    elif pend is not None:
+        padded, _cnt = pend
+        shape = tuple(padded.shape)
+        stages.append(Stage(0, "filter compaction (pending)", shape,
+                            np.dtype(padded.dtype), 1,
+                            _spec(mesh, shape, 1), dynamic=True,
+                            note="survivor count not yet synced"))
+        diags.append(Diagnostic(
+            "BLT008", 0,
+            "the result shape is dynamic: at most %d records survive; "
+            "reading .shape syncs one scalar from device" % shape[0]))
+        rep = Report(target, stages, diags, dynamic=True)
+        engine.record_diagnostics(len(diags))
+        return rep
+    else:
+        aval = arr._aval
+        shape = tuple(aval.shape)
+        stages.append(Stage(0, "base (concrete)", shape,
+                            np.dtype(aval.dtype), arr._split,
+                            _spec(mesh, shape, arr._split)))
+        _idle_device_check(mesh, shape, arr._split, 0, diags, idle_seen)
+        rep = Report(target, stages, diags)
+        engine.record_diagnostics(len(diags))
+        return rep
+
+    # ---- stage 0: the chain base ------------------------------------
+    if getattr(base, "is_deleted", lambda: False)():
+        diags.append(Diagnostic(
+            "BLT005", 0,
+            "the chain base buffer has been deleted (donated to a "
+            "swap(donate=True) or consumed by a donating terminal); "
+            "materialising this pipeline will raise",
+            hint="rebuild the pipeline from a live source array"))
+        rep = Report(target, stages, diags)
+        engine.record_diagnostics(len(diags))
+        return rep
+
+    aval = jax.ShapeDtypeStruct(tuple(base.shape), base.dtype)
+    stages.append(Stage(0, "base", aval.shape, np.dtype(aval.dtype),
+                        walk_split, _spec(mesh, aval.shape, walk_split)))
+    idle_seen = _idle_device_check(mesh, aval.shape, walk_split, 0,
+                                   diags, idle_seen)
+
+    # ---- the deferred map chain, one abstract stage per func --------
+    failed = False
+    for i, func in enumerate(funcs):
+        label = _func_label(func)
+        try:
+            nxt = _stage_eval(func, walk_split, aval)
+        except Exception as exc:
+            first = str(exc).splitlines()[0] if str(exc) else ""
+            diags.append(Diagnostic(
+                "BLT001", i + 1,
+                "%s fails abstract tracing on input %s %s: %s%s"
+                % (label, tuple(aval.shape), np.dtype(aval.dtype),
+                   type(exc).__name__, ": " + first if first else ""),
+                hint="the stage would fail identically at compile time; "
+                     "fix the callable's shape/dtype contract"))
+            failed = True
+            break
+        old, new = np.dtype(aval.dtype), np.dtype(nxt.dtype)
+        if new.itemsize > old.itemsize:
+            diags.append(Diagnostic(
+                "BLT003", i + 1,
+                "%s widens the pipeline dtype %s -> %s (the materialised "
+                "result costs %dx the base's HBM)"
+                % (label, old, new, new.itemsize // old.itemsize),
+                hint="keep constants in the input dtype or cast back "
+                     "with astype/map(dtype=...) if the widening is "
+                     "unintended"))
+        aval = nxt
+        stages.append(Stage(i + 1, label, aval.shape, np.dtype(aval.dtype),
+                            walk_split, _spec(mesh, aval.shape,
+                                              walk_split)))
+        idle_seen = _idle_device_check(mesh, aval.shape, walk_split,
+                                       i + 1, diags, idle_seen)
+
+    if not failed and fp is None:
+        # the chain's recorded result aval must agree with the derived one
+        rec = arr._aval
+        if rec is not None and (tuple(rec.shape) != tuple(aval.shape)
+                                or np.dtype(rec.dtype)
+                                != np.dtype(aval.dtype)):
+            diags.append(Diagnostic(
+                "BLT002", len(funcs),
+                "the recorded result aval %s %s contradicts what the "
+                "chain actually produces (%s %s)"
+                % (tuple(rec.shape), np.dtype(rec.dtype),
+                   tuple(aval.shape), np.dtype(aval.dtype)),
+                hint="a value_shape/dtype hint lied, or deferred state "
+                     "was constructed by hand; trust the derived aval"))
+
+    if not failed and fp is not None:
+        # ---- the deferred filter: predicate + dynamic compaction ----
+        pidx = len(funcs) + 1
+        mapped_ok = (prod(aval.shape[:walk_split]) == n
+                     and tuple(aval.shape[walk_split:]) == tuple(vshape)
+                     and np.dtype(aval.dtype) == np.dtype(vdtype))
+        if not mapped_ok:
+            diags.append(Diagnostic(
+                "BLT002", pidx,
+                "the recorded filter state (n=%d, value shape %s, dtype "
+                "%s) contradicts the mapped chain result %s %s"
+                % (n, tuple(vshape), np.dtype(vdtype),
+                   tuple(aval.shape), np.dtype(aval.dtype)),
+                hint="deferred filter state was constructed by hand or "
+                     "the chain drifted; rebuild via filter()"))
+        try:
+            from bolt_tpu.tpu.array import _cached_eval_shape
+            paval = _cached_eval_shape(
+                ("filter", pred, tuple(vshape), str(np.dtype(vdtype))),
+                lambda: jax.eval_shape(
+                    pred, jax.ShapeDtypeStruct(tuple(vshape),
+                                               np.dtype(vdtype))))
+        except Exception as exc:
+            first = str(exc).splitlines()[0] if str(exc) else ""
+            diags.append(Diagnostic(
+                "BLT001", pidx,
+                "filter predicate %s fails abstract tracing: %s%s"
+                % (_name(pred), type(exc).__name__,
+                   ": " + first if first else ""),
+                hint="the predicate must trace over one value block"))
+        else:
+            if prod(tuple(getattr(paval, "shape", ()))) != 1:
+                diags.append(Diagnostic(
+                    "BLT007", pidx,
+                    "filter predicate %s returns shape %s per record; it "
+                    "must reduce each value block to ONE truth value"
+                    % (_name(pred), tuple(paval.shape)),
+                    hint="reduce inside the predicate, e.g. "
+                         "lambda v: (v > 0).all()"))
+        out_shape = (n,) + tuple(vshape)
+        stages.append(Stage(pidx, "filter(%s)" % _name(pred), out_shape,
+                            np.dtype(vdtype), 1, _spec(mesh, out_shape, 1),
+                            dynamic=True,
+                            note="survivor count pending (<= %d)" % n))
+        diags.append(Diagnostic(
+            "BLT008", pidx,
+            "the result shape is dynamic: at most %d records survive the "
+            "predicate; reading .shape dispatches the fused compaction "
+            "and syncs one scalar" % n))
+        dynamic = True
+
+    if will_donate and not failed:
+        nbytes = int(base.nbytes)
+        diags.append(Diagnostic(
+            "BLT006", len(stages) - 1,
+            "the next dispatching terminal will DONATE the %d-byte chain "
+            "base to XLA (sole owner, >= engine.donation_min_bytes()); "
+            "this array serves exactly ONE terminal and then becomes "
+            "unreadable" % nbytes,
+            hint="hold another reference to the source array or scope "
+                 "engine.donation(None) to keep it readable"))
+
+    rep = Report(target, stages, diags, dynamic=dynamic)
+    engine.record_diagnostics(len(diags))
+    return rep
+
+
+def explain(obj):
+    """Human-readable per-stage rendering of :func:`check`'s report."""
+    return str(check(obj))

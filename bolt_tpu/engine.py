@@ -88,6 +88,9 @@ _COUNTERS = {
     "donations": 0,           # terminal buffer donations granted
     "persistent_hits": 0,     # XLA compiles served from the on-disk cache
     "persistent_misses": 0,   # XLA compiles that had to run for real
+    "diagnostics": 0,         # findings emitted by bolt_tpu.analysis.check
+    "strict_checks": 0,       # pre-dispatch checks forced by analysis.strict
+    "strict_rejections": 0,   # dispatches refused on error-severity findings
 }
 
 _MONITORING_HOOKED = False
@@ -117,8 +120,12 @@ def _hook_persistent_monitoring():
 
 
 def counters():
-    """A snapshot dict of the engine counters (monotonic within a
-    process; :func:`reset_counters` zeroes them)."""
+    """A CONSISTENT snapshot dict of the engine counters: the copy is
+    taken under the engine lock — the same lock every increment holds —
+    so a snapshot can never interleave with a half-applied update (e.g.
+    ``aot_compiles`` bumped but its ``compile_seconds`` not yet).
+    Counters are monotonic within a process; :func:`reset_counters`
+    zeroes them."""
     with _LOCK:
         return dict(_COUNTERS)
 
@@ -136,7 +143,8 @@ def clear():
 
 
 def cache_len():
-    return len(_CACHE)
+    with _LOCK:
+        return len(_CACHE)
 
 
 # ---------------------------------------------------------------------
@@ -207,14 +215,24 @@ def persistent_cache_dir():
 # donation policy
 # ---------------------------------------------------------------------
 
+# per-thread scope overrides (a stack; innermost wins) over the
+# process-wide default _DONATE_MIN_BYTES
+_DONATE_TLS = threading.local()
+
+
 def donation_min_bytes():
-    """Current donation floor in bytes, or ``None`` when terminal
-    donation is disabled."""
+    """Effective donation floor in bytes for the calling thread
+    (innermost :func:`donation` scope, else the process default), or
+    ``None`` when terminal donation is disabled."""
+    st = getattr(_DONATE_TLS, "stack", None)
+    if st:
+        return st[-1]
     return _DONATE_MIN_BYTES
 
 
 def set_donation_min_bytes(n):
-    """Set the donation floor (``None`` disables terminal donation)."""
+    """Set the PROCESS-WIDE donation floor (``None`` disables terminal
+    donation); per-thread :func:`donation` scopes override it."""
     global _DONATE_MIN_BYTES
     _DONATE_MIN_BYTES = None if n is None else int(n)
 
@@ -226,19 +244,72 @@ def donation(min_bytes):
         with bolt_tpu.engine.donation(0):      # donate at any size
             out = bolt.ones(shape, mesh).map(f).sum()
 
-    ``donation(None)`` disables donation inside the scope."""
-    prev = _DONATE_MIN_BYTES
-    set_donation_min_bytes(min_bytes)
+    ``donation(None)`` disables donation inside the scope.  The scope is
+    THREAD-LOCAL (like ``bolt.precision``): one thread's one-shot-chain
+    scope must not flip donation on for a concurrent interactive thread,
+    whose arrays would silently become single-terminal."""
+    st = getattr(_DONATE_TLS, "stack", None)
+    if st is None:
+        st = _DONATE_TLS.stack = []
+    st.append(None if min_bytes is None else int(min_bytes))
     try:
         yield
     finally:
-        set_donation_min_bytes(prev)
+        st.pop()
 
 
 def donation_granted():
     """Count a granted terminal donation (called by the op layers)."""
     with _LOCK:
         _COUNTERS["donations"] += 1
+
+
+# ---------------------------------------------------------------------
+# static-analysis integration (bolt_tpu.analysis)
+# ---------------------------------------------------------------------
+#
+# The abstract pipeline checker feeds the ``diagnostics`` counter on
+# every check; an ``analysis.strict()`` scope installs a pre-dispatch
+# guard here so the engine runs the checker before every compiling
+# terminal and refuses to dispatch on error-severity findings.  The
+# slot is a plain module global consulted by the op layers right before
+# they enter :func:`get` — one attribute read when inactive.
+
+_STRICT_GUARD = None
+
+
+def set_strict_guard(fn):
+    """Install (or clear, with ``None``) the pre-dispatch checker hook —
+    owned by :func:`bolt_tpu.analysis.strict`."""
+    global _STRICT_GUARD
+    _STRICT_GUARD = fn
+
+
+def strict_guard(arr, op):
+    """Run the installed pre-dispatch checker on ``arr`` for terminal
+    ``op`` (no-op when no :func:`bolt_tpu.analysis.strict` scope is
+    active).  Called by the op layers immediately before a dispatching
+    terminal enters :func:`get`."""
+    g = _STRICT_GUARD
+    if g is not None:
+        g(arr, op)
+
+
+def record_diagnostics(n):
+    """Tally ``n`` checker findings (fed by ``bolt_tpu.analysis.check``)."""
+    if n:
+        with _LOCK:
+            _COUNTERS["diagnostics"] += n
+
+
+def strict_checked():
+    with _LOCK:
+        _COUNTERS["strict_checks"] += 1
+
+
+def strict_rejected():
+    with _LOCK:
+        _COUNTERS["strict_rejections"] += 1
 
 
 # ---------------------------------------------------------------------
@@ -351,6 +422,13 @@ def get(key, builder):
     # build OUTSIDE the lock: builders may trace (slow) and re-enter
     entry = _Dispatch(builder())
     with _LOCK:
+        # a concurrent miss may have built and inserted first; keep the
+        # WINNER (it may already hold compiled executables) and discard
+        # this build, or a third thread would compile yet again
+        existing = _CACHE.get(key)
+        if existing is not None:
+            _CACHE.move_to_end(key)
+            return existing
         _CACHE[key] = entry
         if len(_CACHE) > CACHE_MAX:
             _CACHE.popitem(last=False)

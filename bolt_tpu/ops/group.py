@@ -19,11 +19,14 @@ from bolt_tpu._compat import shard_map as _shard_map
 _OPS = ("sum", "mean", "max", "min")
 
 
-@jax.jit
+@jax.jit  # lint: allow(BLT101 one module-level program, keyed on ONE aval)
 def _minmax_program(lab):
     # module-level jit: ONE compiled program per label aval (a per-call
     # inner @jax.jit would recompile every call — jit keys on function
-    # identity; measured 1.09 s vs 0.11 s per segment_reduce on chip)
+    # identity; measured 1.09 s vs 0.11 s per segment_reduce on chip).
+    # Deliberately NOT engine-routed: the engine key would have to carry
+    # the aval this jit already keys on, for a two-scalar program with
+    # nothing to donate or persist.
     return jnp.min(lab), jnp.max(lab)
 
 
@@ -451,7 +454,8 @@ def unique(b, return_counts=False):
 
     sorted_, mask, cnt = _cached_jit(
         ("unique-sort", funcs, base.shape, str(base.dtype), split, mesh),
-        lambda: _unique_phase1(funcs, split, None, None))(_check_live(base))
+        lambda: jax.jit(_unique_phase1(funcs, split, None,
+                                       None)))(_check_live(base))
     k = int(jax.device_get(cnt))               # the one unavoidable sync
 
     # n is the chain-OUTPUT element count (a shape-changing map can alter
@@ -459,7 +463,7 @@ def unique(b, return_counts=False):
     out = jax.device_get(_cached_jit(
         ("unique-gather", funcs, base.shape, str(base.dtype), split, n, k,
          return_counts, mesh),
-        lambda: _unique_phase2(n, k, return_counts))(sorted_, mask))
+        lambda: jax.jit(_unique_phase2(n, k, return_counts)))(sorted_, mask))
     uniq = np.asarray(out[0])
     if return_counts:
         return uniq, np.asarray(out[1]).astype(np.int64)
@@ -510,8 +514,10 @@ def _merge_unique_parts(vals_parts, cnt_parts, return_counts):
 
 
 def _unique_phase1(funcs, split, start, stop):
-    """Phase-1 program: :func:`_sort_mask` over (a ``[start:stop)``
-    slice of) the flattened chain output."""
+    """Phase-1 traced body: :func:`_sort_mask` over (a ``[start:stop)``
+    slice of) the flattened chain output.  Returns the UNJITTED
+    callable — the engine builder at the call site jits it, so
+    compilation stays on the engine's counted AOT path (lint BLT101)."""
     from bolt_tpu.tpu.array import _chain_apply
 
     def run(d):
@@ -519,14 +525,15 @@ def _unique_phase1(funcs, split, start, stop):
         if start is not None:
             flat = jax.lax.slice_in_dim(flat, start, stop)
         return _sort_mask(flat)
-    return jax.jit(run)
+    return run
 
 
 def _unique_phase2(m, size, return_counts):
-    """Phase-2 program: :func:`_gather_uniques` as its own jit."""
+    """Phase-2 traced body: :func:`_gather_uniques` (unjitted — the
+    engine builder at the call site jits it)."""
     def run(s, msk):
         return _gather_uniques(s, msk, m, size, return_counts)
-    return jax.jit(run)
+    return run
 
 
 # bincount accumulates per-chunk below this element count when the
@@ -641,16 +648,16 @@ def _unique_chunked(b, return_counts):
         sorted_, mask, cnt = _cached_jit(
             ("unique-chunk-sort", data.shape, str(data.dtype), start,
              stop, mesh),
-            lambda start=start, stop=stop: _unique_phase1(
-                (), 0, start, stop))(data)
+            lambda start=start, stop=stop: jax.jit(_unique_phase1(
+                (), 0, start, stop)))(data)
         k = int(jax.device_get(cnt))
         kpad = 1 << max(0, (k - 1).bit_length())
 
         out = jax.device_get(_cached_jit(
             ("unique-chunk-gather", str(data.dtype), m, kpad,
              return_counts, mesh),
-            lambda m=m, kpad=kpad: _unique_phase2(
-                m, kpad, return_counts))(sorted_, mask))
+            lambda m=m, kpad=kpad: jax.jit(_unique_phase2(
+                m, kpad, return_counts)))(sorted_, mask))
         vals_parts.append(np.asarray(out[0])[:k])
         if return_counts:
             cnt_parts.append(np.asarray(out[1])[:k].astype(np.int64))

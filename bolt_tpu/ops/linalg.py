@@ -38,6 +38,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu._precision import resolve as _resolve
 from bolt_tpu.utils import prod
 
 
@@ -247,7 +248,7 @@ def svdvals(x, gram_ratio=4):
     x = _widen(jnp.asarray(x), jnp)
     rows, cols = x.shape[-2], x.shape[-1]
     if rows >= gram_ratio * cols:
-        g = jnp.matmul(_adjoint(x), x, precision="highest",
+        g = jnp.matmul(_adjoint(x), x, precision=_resolve("highest"),
                        preferred_element_type=_acc_dtype(x.dtype))
         ev = _gram_eigvalsh(g)                         # ascending, real
         ev = jnp.maximum(ev[..., ::-1], 0.0)           # descending, clamped
@@ -365,11 +366,11 @@ def lstsq(a, b):
     if vec:
         b = b[..., None]
     q, r = tsqr(a)
-    y = jnp.matmul(_adjoint(q), b, precision="highest")
+    y = jnp.matmul(_adjoint(q), b, precision=_resolve("highest"))
     x = jax.scipy.linalg.solve_triangular(r, y, lower=False)
     # one refinement pass: e = y - r x at full precision repairs the
     # solve's blocked-matmul rounding (see tsqr's r_inv note)
-    e = y - jnp.matmul(r, x, precision="highest")
+    e = y - jnp.matmul(r, x, precision=_resolve("highest"))
     x = x + jax.scipy.linalg.solve_triangular(r, e, lower=False)
     return x[..., 0] if vec else x
 
@@ -394,7 +395,7 @@ def tallskinny_svd(x, k=None):
     vec, ev = _gram_decompose(x, _check_k(k, d), jnp, _tpu_eigh)
     s = jnp.sqrt(ev)
     safe = jnp.where(s > 0, s, 1.0)
-    u = jnp.matmul(x, vec, precision="highest") / safe[..., None, :]
+    u = jnp.matmul(x, vec, precision=_resolve("highest")) / safe[..., None, :]
     u = jnp.where(s[..., None, :] > 0, u, 0.0)
     return u, s.astype(_real_dtype(x.dtype)), _adjoint(vec)
 
@@ -420,7 +421,7 @@ def tsqr(x):
     eye = jnp.eye(d, dtype=x.dtype)
 
     def _chol_qr(a):
-        g = jnp.matmul(_adjoint(a), a, precision="highest",
+        g = jnp.matmul(_adjoint(a), a, precision=_resolve("highest"),
                        preferred_element_type=_acc_dtype(a.dtype))
         l = jnp.linalg.cholesky(g)                       # g = l @ l^H
         r = _adjoint(l)
@@ -432,14 +433,14 @@ def tsqr(x):
         # own rounding back to f32 eps.
         r_inv = _adjoint(jax.scipy.linalg.solve_triangular(
             l, jnp.broadcast_to(eye, l.shape), lower=True))
-        correction = 2.0 * eye - jnp.matmul(r, r_inv, precision="highest")
-        r_inv = jnp.matmul(r_inv, correction, precision="highest")
-        q = jnp.matmul(a, r_inv, precision="highest")
+        correction = 2.0 * eye - jnp.matmul(r, r_inv, precision=_resolve("highest"))
+        r_inv = jnp.matmul(r_inv, correction, precision=_resolve("highest"))
+        q = jnp.matmul(a, r_inv, precision=_resolve("highest"))
         return q, r
 
     q1, r1 = _chol_qr(x)
     q, r2 = _chol_qr(q1)                                 # re-orthogonalise
-    return q, jnp.matmul(r2, r1, precision="highest")
+    return q, jnp.matmul(r2, r1, precision=_resolve("highest"))
 
 
 def pca(b, k=None, center=False, axis=None, return_mean=False,

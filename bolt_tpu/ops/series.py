@@ -18,6 +18,8 @@ from functools import lru_cache
 import numpy as np
 import jax.numpy as jnp
 
+from bolt_tpu._precision import resolve as _resolve
+
 
 def _value_axis(b, axis):
     """Resolve ONE value-axis index (relative to the value group)."""
@@ -79,8 +81,11 @@ def _detrend_fn(length, order, ax):
         p_ = xp.asarray(pinv_a, dtype=dt)
         moved = xp.moveaxis(v.astype(dt), ax, -1)
         if xp is jnp:
-            coef = jnp.matmul(moved, p_.T, precision="highest")
-            fit = jnp.matmul(coef, a_.T, precision="highest")
+            # deliberate pin through the resolver (explicit always wins):
+            # the fit matrices are f32/f64 host constants — a bf16 pass
+            # here would dominate the detrend residual
+            coef = jnp.matmul(moved, p_.T, precision=_resolve("highest"))
+            fit = jnp.matmul(coef, a_.T, precision=_resolve("highest"))
         else:
             coef = moved @ p_.T
             fit = coef @ a_.T

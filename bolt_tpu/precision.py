@@ -13,6 +13,24 @@ that statement yields the context-manager function, not this module
 alias for 0.4.x callers.
 """
 
+import sys
+import types
+
 from bolt_tpu._precision import MODES, precision, resolve  # noqa: F401
 
 __all__ = ["MODES", "precision", "resolve"]
+
+
+class _CallableAlias(types.ModuleType):
+    """Loading this alias module makes the import machinery setattr it
+    onto the parent package AFTER this body runs — clobbering the
+    re-exported context-manager function, so a later
+    ``bolt_tpu.precision("default")`` would hit a module object.  Making
+    the module itself callable (delegating to the context manager) keeps
+    both spellings working in either order."""
+
+    def __call__(self, mode):
+        return precision(mode)
+
+
+sys.modules[__name__].__class__ = _CallableAlias

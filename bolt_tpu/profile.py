@@ -162,9 +162,13 @@ def engine_counters():
     (the persistent on-disk cache drives ``compile_seconds`` to ~0 in a
     warm process), ``dispatches``/``dispatch_seconds`` host-side launch
     accounting, ``fallbacks`` (dispatches the AOT path could not serve),
-    ``donations`` (terminal buffer donations granted), and
+    ``donations`` (terminal buffer donations granted),
     ``persistent_hits``/``persistent_misses`` for the on-disk XLA
-    cache."""
+    cache, and the static-analysis tallies: ``diagnostics`` (findings
+    emitted by ``bolt_tpu.analysis.check``), ``strict_checks`` /
+    ``strict_rejections`` (pre-dispatch checks run and dispatches
+    refused inside an ``analysis.strict()`` scope).  The snapshot is
+    consistent — taken under the same lock every increment holds."""
     from bolt_tpu import engine
     return engine.counters()
 

@@ -444,6 +444,15 @@ def _chain_apply(funcs, split, data):
     return out
 
 
+def _pred_mask(pred, flat):
+    """Filter predicate as a bool mask over flattened records — the ONE
+    coercion rule (`asarray(...,bool).reshape(())` per record) shared by
+    the compaction program and both fused filter terminals, so the
+    paths' semantics cannot diverge."""
+    return jax.vmap(
+        lambda v: jnp.asarray(pred(v), dtype=bool).reshape(()))(flat)
+
+
 class BoltArrayTPU(BoltArray):
     """Distributed n-d array: key axes sharded over a TPU mesh, value axes
     local to each device."""
@@ -489,6 +498,12 @@ class BoltArrayTPU(BoltArray):
             self._resolve_fpending()
         if self._pending is not None:
             self._resolve_pending()
+        if self._aval is None:
+            # a filter array consumed by a donating terminal: its count
+            # was never synced, so the metadata is unknowable — raise
+            # the named donation guard, not AttributeError (chain-
+            # donated arrays keep answering from their recorded aval)
+            self._guard_donated()
         return tuple(self._aval.shape)
 
     @property
@@ -499,6 +514,8 @@ class BoltArrayTPU(BoltArray):
         if self._pending is not None:
             # dtype is known without syncing the survivor count
             return np.dtype(self._pending[0].dtype)
+        if self._aval is None:
+            self._guard_donated()   # consumed filter (see shape)
         return np.dtype(self._aval.dtype)
 
     @property
@@ -528,16 +545,37 @@ class BoltArrayTPU(BoltArray):
         pending too: its survivor count is equally unknown."""
         return self._pending is not None or self._fpending is not None
 
-    def _consume_donated(self):
-        """Mark this array consumed by a donating pipeline terminal: its
+    def _consume_donated(self, op="a donating pipeline terminal",
+                         granted=True):
+        """Mark this array consumed by the donating operation ``op``: its
         chain base buffer was handed to XLA, so the chain can never be
-        re-materialised — reads now raise the same guard as
-        ``swap(donate=True)``."""
+        re-materialised — reads now raise the :meth:`_guard_donated`
+        gate, whose message names ``op`` (so a use-after-donate error
+        says WHICH terminal consumed the buffer).  ``granted=False``
+        records the donation without counting it as an engine-policy
+        grant (``swap(donate=True)`` is user-explicit, not granted)."""
         self._chain = None
         self._concrete = None
         self._fpending = None
-        self._donated = True
-        _engine.donation_granted()
+        self._donated = op
+        if granted:
+            _engine.donation_granted()
+
+    def _guard_donated(self):
+        """THE donation gate: every read of this array's device state
+        goes through here (via ``._data``); a buffer consumed by a
+        donating terminal raises, naming the consuming operation.  The
+        repo linter (BLT104) forbids ``._concrete`` reads that would
+        skip this gate."""
+        if self._donated:
+            op = self._donated if isinstance(self._donated, str) \
+                else "a donating pipeline terminal"
+            raise RuntimeError(
+                "this array's device buffer was donated to %s and can no "
+                "longer be read (donation-aware terminals consume a "
+                "sole-owned array; scope bolt_tpu.engine.donation(None) "
+                "to keep sources readable, and bolt_tpu.analysis.check "
+                "flags this before dispatch)" % op)
 
     def _resolve_fpending(self):
         """Dispatch the deferred filter's fused compaction program (ONE
@@ -548,6 +586,7 @@ class BoltArrayTPU(BoltArray):
         buffer to the program (the compaction buffer is input-sized)."""
         if self._fpending is None:
             return
+        _engine.strict_guard(self, "filter() compaction")
         donate = _chain_donate_ok(self._fpending)   # [0] is the base
         base, funcs, func, split, vshape, n, _ = self._fpending
         mesh = self._mesh
@@ -556,8 +595,7 @@ class BoltArrayTPU(BoltArray):
             def fused(data):
                 mapped = _chain_apply(funcs, split, data)
                 flat = mapped.reshape((n,) + vshape)
-                mask = jax.vmap(
-                    lambda v: jnp.asarray(func(v), dtype=bool).reshape(()))(flat)
+                mask = _pred_mask(func, flat)
                 # survivor indices in increasing (key) order, padded with 0s
                 # beyond the count — rows past the count are garbage and are
                 # sliced away at resolution
@@ -606,16 +644,13 @@ class BoltArrayTPU(BoltArray):
     def _data(self):
         """The concrete sharded ``jax.Array``; materialises a deferred
         chain on first access (one fused compiled program)."""
-        if self._donated:
-            raise RuntimeError(
-                "this array's device buffer was donated to a swap(...,"
-                " donate=True) or consumed by a donating pipeline "
-                "terminal; it can no longer be read")
+        self._guard_donated()
         if self._fpending is not None:
             self._resolve_fpending()
         if self._pending is not None:
             self._resolve_pending()
         if self._concrete is None:
+            _engine.strict_guard(self, "map-chain materialisation")
             # chained-map terminal: a sole-owned base donates its buffer
             # to the materialising program (the output is input-sized, so
             # XLA aliases them — one buffer instead of two)
@@ -840,9 +875,7 @@ class BoltArrayTPU(BoltArray):
 
         def build():
             def masker(data):
-                flat = data.reshape((n,) + vshape)
-                return jax.vmap(
-                    lambda v: jnp.asarray(func(v), dtype=bool).reshape(()))(flat)
+                return _pred_mask(func, data.reshape((n,) + vshape))
             return jax.jit(masker)
 
         mask = _cached_jit(("filter-mask", func, aligned.shape,
@@ -881,6 +914,7 @@ class BoltArrayTPU(BoltArray):
         input fuses into the same program (map→reduce reads HBM once).
         """
         func = _traceable(func)
+        _engine.strict_guard(self, "reduce()")
         if self._fpending is not None:
             # deferred filter feeding the reduce: fold the predicate into
             # the pairwise tree — one fused HBM pass (see
@@ -945,7 +979,7 @@ class BoltArrayTPU(BoltArray):
                           split, keepdims, donate, mesh), build)
         out = self._wrap(fn(_check_live(base)), new_split)
         if donate:
-            aligned._consume_donated()
+            aligned._consume_donated("reduce()")
         return out
 
     # ------------------------------------------------------------------
@@ -955,6 +989,7 @@ class BoltArrayTPU(BoltArray):
     # ------------------------------------------------------------------
 
     def _stat(self, axis, name, keepdims=False, ddof=None):
+        _engine.strict_guard(self, "%s()" % name)
         if self._fpending is not None:
             # an unmaterialised filter feeding a reduction: fold the
             # predicate mask straight into the reduce — ONE fused HBM
@@ -995,7 +1030,7 @@ class BoltArrayTPU(BoltArray):
                           split, axes, keepdims, ddof, donate, mesh), build)
         out = self._wrap(fn(_check_live(base)), new_split)
         if donate:
-            self._consume_donated()
+            self._consume_donated("%s()" % name)
         return out
 
     # identity each fusable reduction folds non-surviving records onto:
@@ -1075,8 +1110,7 @@ class BoltArrayTPU(BoltArray):
             def stat(data):
                 mapped = _chain_apply(funcs, psplit, data)
                 flat = mapped.reshape((n,) + tuple(vshape))
-                mask = jax.vmap(lambda v: jnp.asarray(
-                    pred(v), dtype=bool).reshape(()))(flat)
+                mask = _pred_mask(pred, flat)
                 mfull = mask.reshape((n,) + (1,) * len(vshape))
                 cnt = jnp.sum(mask, dtype=jnp.int32)
                 if name in ("sum", "prod", "any", "all", "max", "min"):
@@ -1110,7 +1144,7 @@ class BoltArrayTPU(BoltArray):
             # mark consumption BEFORE any error path below: the program
             # already took the buffer, and a zero-survivor raise must
             # leave this array guarded, not pointing at a deleted base
-            self._consume_donated()
+            self._consume_donated("filter().%s()" % name)
         if needs_count:
             out, cnt = out
             if int(jax.device_get(cnt)) == 0:
@@ -1151,8 +1185,7 @@ class BoltArrayTPU(BoltArray):
             def reducer(data):
                 mapped = _chain_apply(funcs, psplit, data)
                 flat = mapped.reshape((n,) + tuple(vshape))
-                mask = jax.vmap(lambda v: jnp.asarray(
-                    pred(v), dtype=bool).reshape(()))(flat)
+                mask = _pred_mask(pred, flat)
                 cnt = jnp.sum(mask, dtype=jnp.int32)
                 vfunc = jax.vmap(func)
 
@@ -1197,7 +1230,7 @@ class BoltArrayTPU(BoltArray):
         if donate:
             # before the zero-survivor raise: the buffer is already gone,
             # so the array must carry the guard, not the deleted base
-            self._consume_donated()
+            self._consume_donated("filter().reduce()")
         if int(jax.device_get(cnt)) == 0:
             # every record was filtered out: same contract as reducing an
             # (0, ...)-shaped resolved result
@@ -2589,9 +2622,9 @@ class BoltArrayTPU(BoltArray):
                           split, new_split, True, mesh), build)
         out = fn(self._data)
         # only after a successful dispatch: a compile failure must not
-        # brick an array whose buffer was never consumed
-        self._concrete = None
-        self._donated = True
+        # brick an array whose buffer was never consumed (granted=False:
+        # user-explicit donation, not an engine-policy grant)
+        self._consume_donated("swap(..., donate=True)", granted=False)
         return self._wrap(out, new_split)
 
     def chunk(self, size="150", axis=None, padding=None):
@@ -3087,6 +3120,18 @@ class BoltArrayTPU(BoltArray):
     def __repr__(self):
         s = "BoltArray\n"
         s += "mode: %s\n" % self.mode
+        if self._donated:
+            # repr must never raise: a donated FILTER array has no aval,
+            # so the shape/dtype properties below would hit the guard —
+            # and printing an array is how users diagnose exactly that
+            if self._aval is not None:
+                s += "shape: %s\n" % str(tuple(self._aval.shape))
+                s += "dtype: %s\n" % str(np.dtype(self._aval.dtype))
+            s += "split: %d\n" % self._split
+            s += "donated: buffer consumed by %s\n" % (
+                self._donated if isinstance(self._donated, str)
+                else "a donating swap or terminal")
+            return s
         if self._fpending is not None:
             # don't dispatch the filter just to print; show what is known
             s += "shape: (%s)\n" % ", ".join(
@@ -3099,9 +3144,7 @@ class BoltArrayTPU(BoltArray):
             s += "shape: %s\n" % str(self.shape)
         s += "split: %d\n" % self._split
         s += "dtype: %s\n" % str(self.dtype)
-        if self._donated:
-            s += "donated: buffer consumed by a donating swap or terminal\n"
-        elif self.deferred:
+        if self.deferred:
             s += "deferred: %d-op map chain\n" % len(self._chain[1])
         elif self._fpending is not None:
             s += "pending: deferred filter (predicate not yet dispatched)\n"

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
+from bolt_tpu import engine as _engine
 from bolt_tpu.parallel.sharding import combined_spec
 from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
                                 _canon, _chain_apply, _chain_donate_ok,
@@ -205,6 +206,7 @@ class ChunkedArray:
         shape so the halo can be trimmed and the tiles reassembled.
         """
         func = _traceable(func)
+        _engine.strict_guard(self._barray, "chunk().map()")
         hint_ob = None
         if value_shape is not None:
             # reference-parity hint: validate the per-block output shape
@@ -308,7 +310,7 @@ class ChunkedArray:
                               donate, mesh), build)
             out = fn(_check_live(base))
             if donate:
-                b._consume_donated()
+                b._consume_donated("chunk().map()")
             new_plan = tuple(o // g for o, g in zip(out.shape[split:], grid))
             return ChunkedArray(BoltArrayTPU(out, split, mesh), new_plan, pad,
                                 vshard)
@@ -386,7 +388,7 @@ class ChunkedArray:
                           donate, mesh), build)
         out = fn(_check_live(base))
         if donate:
-            b._consume_donated()
+            b._consume_donated("chunk().map()")
         return ChunkedArray(BoltArrayTPU(out, split, mesh), plan, pad, vshard)
 
     # ------------------------------------------------------------------

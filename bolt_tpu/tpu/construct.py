@@ -132,12 +132,35 @@ class ConstructTPU:
 
     @staticmethod
     def _filled(fill, shape, context, axis, dtype):
-        from bolt_tpu.tpu.array import BoltArrayTPU
+        from bolt_tpu.tpu.array import BoltArrayTPU, _cached_jit
         mesh, shape, split, dtype, sharding = \
             ConstructTPU._device_build_spec(shape, context, axis, dtype)
-        build = jax.jit(lambda: jnp.full(shape, fill, dtype=dtype),
-                        out_shardings=sharding)
-        return BoltArrayTPU(build(), split, mesh)
+        # engine-routed like every other program: repeated ones()/zeros()
+        # of one geometry reuse ONE counted AOT executable.  Scalar fills
+        # constant-fold into the program (key carries the value);
+        # array-like fills — unhashable, so they cannot key — pass as a
+        # broadcast ARGUMENT instead (key carries only their geometry,
+        # and the cached closure pins no array memory).
+        try:
+            hash(fill)
+            if fill != fill:
+                # NaN: hashable but never equal to itself, so a raw key
+                # would MISS (and insert) on every call — ride the
+                # argument path, keyed on geometry only
+                raise TypeError
+        except TypeError:
+            farr = np.asarray(fill)
+            fn = _cached_jit(
+                ("construct-full-arr", farr.shape, str(farr.dtype),
+                 shape, str(dtype), sharding),
+                lambda: jax.jit(lambda f: jnp.full(shape, f, dtype=dtype),
+                                out_shardings=sharding))
+            return BoltArrayTPU(fn(farr), split, mesh)
+        fn = _cached_jit(
+            ("construct-full", fill, shape, str(dtype), sharding),
+            lambda: jax.jit(lambda: jnp.full(shape, fill, dtype=dtype),
+                            out_shardings=sharding))
+        return BoltArrayTPU(fn(), split, mesh)
 
     @staticmethod
     def _random(kind, shape, context, axis, dtype, seed):

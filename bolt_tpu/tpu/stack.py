@@ -16,6 +16,7 @@ blocks in one compiled program.
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu import engine as _engine
 from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
                                 _canon, _chain_apply, _chain_donate_ok,
                                 _check_live, _check_value_shape, _constrain,
@@ -72,6 +73,7 @@ class StackedArray:
         over a million records compiles as fast as ``size=1000``."""
         func = _traceable(func)
         b = self._barray
+        _engine.strict_guard(b, "stacked().map()")
         split = b.split
         mesh = b.mesh
         kshape = b.shape[:split]
@@ -143,7 +145,7 @@ class StackedArray:
                           mesh), build)
         out = fn(_check_live(base))
         if donate:
-            b._consume_donated()
+            b._consume_donated("stacked().map()")
         return StackedArray(BoltArrayTPU(out, split, mesh), size)
 
     def unstack(self):

@@ -104,6 +104,76 @@ SVALS = lambda blk: jnp.linalg.svd(blk, compute_uv=False)[None, :]
 
 
 # ----------------------------------------------------------------------
+# static-analysis twins: every benchmark config's DEFERRED pipeline at
+# small geometry, for bolt_tpu.analysis.check — the abstract checker
+# must predict each config's result shape/dtype with ZERO XLA compiles
+# (engine misses unchanged).  `python scripts/bench_all.py --check`
+# runs the gate standalone; tests/test_static_analysis.py runs it in
+# tier-1 on the virtual CPU mesh.
+# ----------------------------------------------------------------------
+
+def pipelines(mesh=None, nkeys=16):
+    """``[(config name, pipeline object)]`` — the pre-terminal deferred
+    state of each BASELINE config (map chains, deferred filters, a
+    chunked view over a chain), built at toy sizes on ``mesh`` (default:
+    the process default mesh)."""
+    import bolt_tpu as bolt
+    if mesh is None:
+        from bolt_tpu.parallel import default_mesh
+        mesh = default_mesh()
+    rs = np.random.RandomState(7)
+    k = nkeys
+    x2 = (np.abs(rs.randn(k, 6, 4)) + 0.5).astype(np.float32)
+    x4 = rs.randn(k, 6, 4).astype(np.float32)
+    return [
+        ("1 map->sum", bolt.array(np.ones((k, 8, 4), np.float32),
+                                  mesh).map(ADD1)),
+        ("2 ufunc+reductions", bolt.array(x2, mesh).map(SQRT)),
+        ("3 swap all-to-all", bolt.array(
+            rs.randn(k, 4, 6).astype(np.float32), mesh).map(ADD1)),
+        ("4 filter mask", bolt.array(x4, mesh).filter(MEANPOS)),
+        ("4b filter->sum fused", bolt.array(x4, mesh).filter(MEANPOS)),
+        ("5 per-chunk SVD", bolt.array(
+            rs.randn(8, 32, 4).astype(np.float32),
+            mesh).map(ADD1).chunk(size=(8,), axis=(0,))),
+    ]
+
+
+def check_configs(mesh=None):
+    """Run :func:`bolt_tpu.analysis.check` over every config pipeline;
+    verify zero compiles during checking and that the predicted
+    shape/dtype match the materialised result.  Returns a process exit
+    code (0 ok / 1 any mismatch or compile)."""
+    from bolt_tpu import analysis, engine
+    failed = False
+    for name, arr in pipelines(mesh=mesh):
+        c0 = engine.counters()
+        rep = analysis.check(arr)
+        c1 = engine.counters()
+        compiled = (c1["misses"] - c0["misses"]
+                    + c1["aot_compiles"] - c0["aot_compiles"]
+                    + c1["dispatches"] - c0["dispatches"])
+        print("== %s" % name)
+        print(rep)
+        target = arr.unchunk() if hasattr(arr, "unchunk") else arr
+        got_shape = tuple(target.shape)          # resolves/dispatches NOW
+        got_dtype = np.dtype(target.dtype)
+        pred = rep.shape
+        if rep.dynamic:
+            shape_ok = (pred[0] is None and pred[1:] == got_shape[1:])
+        else:
+            shape_ok = pred == got_shape
+        ok = (shape_ok and np.dtype(rep.dtype) == got_dtype
+              and compiled == 0)
+        print("   predicted %s %s | executed %s %s | compiles during "
+              "check: %d -> %s"
+              % (pred, rep.dtype, got_shape, got_dtype, compiled,
+                 "OK" if ok else "MISMATCH"))
+        failed = failed or not ok
+    return 1 if failed else 0
+
+
+# ----------------------------------------------------------------------
 # Bit-identical pseudo-random data on BOTH sides without moving a byte
 # through the host<->device tunnel (~17 MB/s here: shipping a 2 GB input
 # or fetching a 2 GB result would take ~2 minutes and time the tunnel,
@@ -318,4 +388,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--check" in sys.argv:
+        sys.exit(check_configs())
     main()

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Repo invariant linter CLI — the ``BLT1xx`` AST rules of
+``bolt_tpu/analysis/astlint.py`` as a fast standalone gate.
+
+::
+
+    python scripts/lint_bolt.py             # lint bolt_tpu/, print findings
+    python scripts/lint_bolt.py --check     # same, exit 1 on any finding
+    python scripts/lint_bolt.py --codes     # print the rule table
+    python scripts/lint_bolt.py PATH...     # lint specific files/dirs
+
+Runs in milliseconds with NO jax import: ``astlint`` is stdlib-only and
+is loaded straight from its file, skipping the ``bolt_tpu`` package
+initialisation (which would pull in jax).  The same rules run in tier-1
+as ``pytest -m lint`` (``tests/test_static_analysis.py`` asserts zero
+findings on the package).
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_astlint():
+    """Load astlint by path (no ``import bolt_tpu`` — that would
+    initialise jax; this gate must stay no-jit and instant)."""
+    path = os.path.join(_REPO, "bolt_tpu", "analysis", "astlint.py")
+    spec = importlib.util.spec_from_file_location("bolt_astlint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="AST linter for the bolt_tpu repo invariants "
+                    "(BLT1xx)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "bolt_tpu package)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero when any finding is reported "
+                         "(the CI/tier-1 gate mode)")
+    ap.add_argument("--codes", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    astlint = _load_astlint()
+    if args.codes:
+        for code in sorted(astlint.RULES):
+            print("%s  %s" % (code, astlint.RULES[code]))
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "bolt_tpu")]
+    findings = astlint.lint_paths(paths)
+    for f in findings:
+        print(f.render())
+    n = len(findings)
+    print("%d finding(s) over %s" % (n, ", ".join(paths)))
+    if args.check and n:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -285,6 +285,51 @@ def test_deferred_filter_still_resolves_for_other_consumers(mesh):
     assert np.allclose(f3.toarray(), keep * 2)
 
 
+# ----------------------------------------------------------------------
+# counters: consistent snapshots (ISSUE 2 satellite) + diagnostics feed
+# ----------------------------------------------------------------------
+
+def test_counters_snapshot_is_consistent_under_concurrent_increments():
+    import threading
+    n_threads, per_thread = 4, 500
+    start = engine.counters()["diagnostics"]
+    seen = []
+    stop = threading.Event()
+
+    def snapshotter():
+        while not stop.is_set():
+            seen.append(engine.counters()["diagnostics"])
+
+    def hammer():
+        for _ in range(per_thread):
+            engine.record_diagnostics(1)
+
+    snap = threading.Thread(target=snapshotter)
+    snap.start()
+    workers = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    stop.set()
+    snap.join()
+    # lock-protected increments: nothing lost, snapshots monotonic
+    assert engine.counters()["diagnostics"] == start + n_threads * per_thread
+    assert seen == sorted(seen)
+    # and counters() returns a SNAPSHOT, not a live view
+    c = engine.counters()
+    c["diagnostics"] += 10 ** 6
+    assert engine.counters()["diagnostics"] != c["diagnostics"]
+
+
+def test_engine_counters_include_analysis_tallies(mesh):
+    c = engine.counters()
+    for key in ("diagnostics", "strict_checks", "strict_rejections"):
+        assert key in c
+    txt = profile.engine_report()
+    assert "diagnostics" in txt and "strict_rejections" in txt
+
+
 def test_fused_filter_donates_sole_owned_base(mesh):
     x = _x()
     keep = _keep(x)

@@ -21,6 +21,28 @@ from bolt_tpu.utils import allclose
 from tests.generic import HYPOTHESIS_SETTINGS as SETTINGS
 
 
+def _assert_checker_parity(b, x, applied):
+    """ISSUE 2 satellite: every fuzzed pipeline first runs
+    ``analysis.check`` — the abstract checker must predict the executed
+    result's shape and dtype, with ZERO compiles/dispatches of its own
+    (the engine counters are the proof) and no error findings."""
+    from bolt_tpu import analysis, engine
+    c0 = engine.counters()
+    rep = analysis.check(b)
+    c1 = engine.counters()
+    for k in ("misses", "aot_compiles", "dispatches"):
+        assert c1[k] == c0[k], (k, applied)
+    assert rep.ok, (applied, rep.diagnostics)
+    if rep.dynamic:
+        # un-synced filter count: the leading extent is unknowable
+        # statically; the value dims and dtype are still exact
+        assert rep.shape[0] is None, applied
+        assert rep.shape[1:] == x.shape[1:], (applied, rep.shape, x.shape)
+    else:
+        assert rep.shape == x.shape, (applied, rep.shape, x.shape)
+    assert np.dtype(rep.dtype) == x.dtype, (applied, rep.dtype, x.dtype)
+
+
 def _op_map_affine(draw, b, x):
     a = draw(st.sampled_from([-2.0, 0.5, 3.0]))
     c = draw(st.sampled_from([-1.0, 0.0, 2.5]))
@@ -406,6 +428,7 @@ def test_local_random_pipelines_match_numpy(data, seed, depth):
         applied.append(op.__name__)
         if x.shape[0] == 0:
             break
+    _assert_checker_parity(b, x, applied)
     assert b.shape == x.shape, (applied, b.shape, x.shape)
     assert allclose(b.toarray(), x), applied
     if x.shape[0] > 0:
@@ -427,6 +450,9 @@ def test_random_pipelines_match_numpy(mesh, data, seed, depth):
         applied.append(op.__name__)
         if x.shape[0] == 0:
             break                        # filtered everything away
+    # checker-vs-reality parity BEFORE anything resolves: the abstract
+    # interpretation must agree with what execution then produces
+    _assert_checker_parity(b, x, applied)
     assert b.shape == x.shape, (applied, b.shape, x.shape)
     # dtype-aware tolerance: after an astype(f32) step, device and numpy
     # transcendentals (tanh, …) differ by ~1 ulp and downstream affine

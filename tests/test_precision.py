@@ -220,12 +220,20 @@ def test_tensorsolve_integer_dtype_matches_oracle(mesh):
 
 
 def test_precision_module_alias():
-    """bolt_tpu.precision (the attribute) is the context manager;
+    """bolt_tpu.precision is callable (the context-manager contract);
     bolt_tpu._precision is the module; the legacy from-import keeps
-    working through the alias shim (ADVICE r5 low)."""
+    working through the alias shim (ADVICE r5 low).  Loading the alias
+    module makes the import machinery REPLACE the package attribute
+    with the module object — the alias is therefore itself callable and
+    delegates, so the public scope spelling works before AND after the
+    legacy import (the identity form of this test missed that clobber
+    because the from-import was its last statement)."""
     import bolt_tpu
     import bolt_tpu._precision as mod
     assert callable(bolt_tpu.precision)
-    assert bolt_tpu.precision is mod.precision
     from bolt_tpu.precision import resolve as r2
     assert r2 is mod.resolve
+    assert callable(bolt_tpu.precision)      # survived the clobber
+    with bolt_tpu.precision("default"):
+        assert mod.resolve() == "default"
+    assert mod.resolve() == "highest"

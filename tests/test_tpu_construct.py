@@ -69,6 +69,32 @@ def test_ones_zeros(mesh):
     assert len(b._data.sharding.device_set) == 8
 
 
+def test_full_scalar_and_array_fill(mesh):
+    # scalar fill: engine-keyed constant program, numpy full semantics
+    b = bolt.full((8, 3), 2.5, mesh)
+    assert allclose(b.toarray(), np.full((8, 3), 2.5))
+    # array-like fill broadcasts like np.full — unhashable, so it rides
+    # as a program ARGUMENT, not an engine cache key (regression: the
+    # engine-routed path must not TypeError on hashing an ndarray)
+    fill = np.array([1.0, 2.0, 3.0])
+    a = bolt.full((8, 3), fill, mesh)
+    assert allclose(a.toarray(), np.full((8, 3), fill))
+    # and a repeat of each geometry HITS the executable cache
+    from bolt_tpu import engine
+    c0 = engine.counters()["misses"]
+    bolt.full((8, 3), 2.5, mesh)
+    bolt.full((8, 3), np.array([9.0, 8.0, 7.0]), mesh)
+    assert engine.counters()["misses"] == c0
+    # NaN fills must cache too (NaN != NaN would never match a raw
+    # value key): first call may miss, repeats must hit
+    n = bolt.full((8, 3), np.nan, mesh)
+    assert np.isnan(np.asarray(n.toarray())).all()
+    c1 = engine.counters()["misses"]
+    bolt.full((8, 3), np.nan, mesh)
+    bolt.full((8, 3), np.nan, mesh)
+    assert engine.counters()["misses"] == c1
+
+
 def test_ones_axis(mesh):
     b = bolt.ones((3, 8), mesh, axis=(1,))
     assert b.shape == (8, 3)
