@@ -15,8 +15,8 @@ symbol-level citation, SURVEY.md §0):
 
 __version__ = "0.5.0"
 
-from bolt_tpu.factory import (array, concatenate, fromcallback, full, ones,
-                              rand, randn, zeros)
+from bolt_tpu.factory import (array, concatenate, fromcallback, fromiter,
+                              full, ones, rand, randn, zeros)
 from bolt_tpu.base import BoltArray, HostFallbackWarning
 from bolt_tpu.local.array import BoltArrayLocal
 from bolt_tpu.tpu.array import BoltArrayTPU
@@ -24,12 +24,12 @@ from bolt_tpu._precision import precision
 from bolt_tpu.utils import allclose
 
 __all__ = ["array", "ones", "zeros", "full", "rand", "randn",
-           "fromcallback", "concatenate", "allclose", "precision",
-           "BoltArray", "BoltArrayLocal", "BoltArrayTPU",
+           "fromcallback", "fromiter", "concatenate", "allclose",
+           "precision", "BoltArray", "BoltArrayLocal", "BoltArrayTPU",
            "HostFallbackWarning", "__version__"]
 
 _SUBMODULES = ("analysis", "checkpoint", "engine", "profile", "parallel",
-               "ops", "statcounter", "utils")
+               "ops", "statcounter", "stream", "utils")
 
 
 def __getattr__(name):

@@ -45,6 +45,7 @@ RULES = {
     "BLT102": "version-sensitive jax API outside bolt_tpu/_compat.py",
     "BLT103": "precision= literal bypassing _precision.resolve()",
     "BLT104": "._concrete access bypassing the _guard_donated gate",
+    "BLT105": "raw jax.device_put outside the stream transfer layer",
 }
 
 # rule -> path suffixes (os-normalised) exempt from it
@@ -53,6 +54,8 @@ _EXEMPT = {
     "BLT102": ("_compat.py",),
     "BLT103": ("_precision.py",),
     "BLT104": (os.path.join("tpu", "array.py"),),
+    # stream.transfer IS the counted device_put wrapper
+    "BLT105": ("stream.py",),
 }
 
 _VERSION_SENSITIVE = {
@@ -106,8 +109,12 @@ def _dotted(node):
 
 
 def _exempt(code, path):
+    """Suffix match ANCHORED on a path separator: ``upstream.py`` must
+    not inherit ``stream.py``'s exemption (nor ``myengine.py``
+    ``engine.py``'s)."""
     norm = os.path.normpath(path)
-    return any(norm.endswith(suffix) for suffix in _EXEMPT[code])
+    return any(norm == suffix or norm.endswith(os.sep + suffix)
+               for suffix in _EXEMPT[code])
 
 
 def _builder_regions(tree):
@@ -277,6 +284,14 @@ def lint_source(src, path="<string>"):
             emit("BLT104", node,
                  "._concrete bypasses the _guard_donated donation gate; "
                  "read ._data instead")
+
+        # ---- BLT105: raw jax.device_put outside stream.transfer --------
+        if isinstance(node, ast.Call) \
+                and resolved(node.func) == "jax.device_put":
+            emit("BLT105", node,
+                 "raw jax.device_put bypasses the counted transfer layer "
+                 "(transfer_bytes/transfer_seconds stay blind); route it "
+                 "through bolt_tpu.stream.transfer")
 
     findings.sort(key=lambda f: (f.line, f.col))
     return findings

@@ -114,6 +114,37 @@ def _spec(mesh, shape, split):
         return None
 
 
+def _check_predicate(pred, vshape, vdtype, idx, diags):
+    """Abstractly trace a filter predicate over one value block and emit
+    BLT001 (trace failure) / BLT007 (non-scalar per record) — the ONE
+    predicate contract, shared by the deferred-filter and streaming-plan
+    walks so their diagnostics cannot drift."""
+    try:
+        from bolt_tpu.tpu.array import _cached_eval_shape
+        paval = _cached_eval_shape(
+            ("filter", pred, tuple(vshape), str(np.dtype(vdtype))),
+            lambda: jax.eval_shape(
+                pred, jax.ShapeDtypeStruct(tuple(vshape),
+                                           np.dtype(vdtype))))
+    except Exception as exc:
+        first = str(exc).splitlines()[0] if str(exc) else ""
+        diags.append(Diagnostic(
+            "BLT001", idx,
+            "filter predicate %s fails abstract tracing: %s%s"
+            % (_name(pred), type(exc).__name__,
+               ": " + first if first else ""),
+            hint="the predicate must trace over one value block"))
+    else:
+        if prod(tuple(getattr(paval, "shape", ()))) != 1:
+            diags.append(Diagnostic(
+                "BLT007", idx,
+                "filter predicate %s returns shape %s per record; it "
+                "must reduce each value block to ONE truth value"
+                % (_name(pred), tuple(paval.shape)),
+                hint="reduce inside the predicate, e.g. "
+                     "lambda v: (v > 0).all()"))
+
+
 def check(obj):
     """Abstractly interpret ``obj``'s recorded pipeline; returns a
     :class:`~bolt_tpu.analysis.diagnostics.Report`.
@@ -161,6 +192,14 @@ def check(obj):
                  "policy with engine.donation(None) before the "
                  "consuming terminal"))
         rep = Report(target, stages, diags)
+        engine.record_diagnostics(len(diags))
+        return rep
+
+    if arr._stream is not None:
+        # streaming plan (bolt_tpu.stream): walk the recorded stage
+        # chain abstractly — same _stage_apply bodies the per-slab
+        # program traces, eval_shape only, ZERO XLA compiles
+        rep = _check_stream(arr, target, stages, diags)
         engine.record_diagnostics(len(diags))
         return rep
 
@@ -286,30 +325,7 @@ def check(obj):
                    tuple(aval.shape), np.dtype(aval.dtype)),
                 hint="deferred filter state was constructed by hand or "
                      "the chain drifted; rebuild via filter()"))
-        try:
-            from bolt_tpu.tpu.array import _cached_eval_shape
-            paval = _cached_eval_shape(
-                ("filter", pred, tuple(vshape), str(np.dtype(vdtype))),
-                lambda: jax.eval_shape(
-                    pred, jax.ShapeDtypeStruct(tuple(vshape),
-                                               np.dtype(vdtype))))
-        except Exception as exc:
-            first = str(exc).splitlines()[0] if str(exc) else ""
-            diags.append(Diagnostic(
-                "BLT001", pidx,
-                "filter predicate %s fails abstract tracing: %s%s"
-                % (_name(pred), type(exc).__name__,
-                   ": " + first if first else ""),
-                hint="the predicate must trace over one value block"))
-        else:
-            if prod(tuple(getattr(paval, "shape", ()))) != 1:
-                diags.append(Diagnostic(
-                    "BLT007", pidx,
-                    "filter predicate %s returns shape %s per record; it "
-                    "must reduce each value block to ONE truth value"
-                    % (_name(pred), tuple(paval.shape)),
-                    hint="reduce inside the predicate, e.g. "
-                         "lambda v: (v > 0).all()"))
+        _check_predicate(pred, vshape, vdtype, pidx, diags)
         out_shape = (n,) + tuple(vshape)
         stages.append(Stage(pidx, "filter(%s)" % _name(pred), out_shape,
                             np.dtype(vdtype), 1, _spec(mesh, out_shape, 1),
@@ -336,6 +352,81 @@ def check(obj):
     rep = Report(target, stages, diags, dynamic=dynamic)
     engine.record_diagnostics(len(diags))
     return rep
+
+
+def _check_stream(arr, target, stages, diags):
+    """Abstractly interpret a STREAMING plan (a lazy ``fromcallback``/
+    ``fromiter`` source plus its recorded device-side stages).  Nothing
+    uploads, compiles or streams — each stage evaluates through the SAME
+    ``stream._stage_apply`` body the per-slab executable traces."""
+    from bolt_tpu import stream as _stream
+    src = arr._stream
+    mesh = arr._mesh
+    walk_split = src.split
+    nslabs = -(-src.shape[0] // src.slab) if src.shape[0] else 0
+    aval = jax.ShapeDtypeStruct(tuple(src.shape), src.dtype)
+    stages.append(Stage(
+        0, "stream source (%s)" % src.kind, aval.shape,
+        np.dtype(aval.dtype), walk_split,
+        _spec(mesh, aval.shape, walk_split),
+        note="out-of-core: ~%d slabs of %d records, prefetch depth %d"
+             % (nslabs, src.slab, _stream.prefetch_depth())))
+    idle_seen = _idle_device_check(mesh, aval.shape, walk_split, 0, diags,
+                                   False)
+    dynamic = False
+    for i, stage in enumerate(src.stages):
+        idx = i + 1
+        if stage[0] == "filter":
+            pred = stage[1]
+            n = prod(aval.shape[:walk_split])
+            vshape = tuple(aval.shape[walk_split:])
+            _check_predicate(pred, vshape, aval.dtype, idx, diags)
+            out_shape = (n,) + vshape
+            stages.append(Stage(idx, "filter(%s) [streamed]" % _name(pred),
+                                out_shape, np.dtype(aval.dtype), 1,
+                                _spec(mesh, out_shape, 1), dynamic=True,
+                                note="survivor count pending (<= %d); "
+                                     "streamed reductions fold the mask "
+                                     "per slab" % n))
+            diags.append(Diagnostic(
+                "BLT008", idx,
+                "the result shape is dynamic: at most %d records survive "
+                "the predicate; streamed reduction terminals fold the "
+                "mask without materialising, any other consumer "
+                "materialises the whole source" % n))
+            dynamic = True
+            break
+        label = "%s [streamed]" % _stream.stage_label(stage)
+        try:
+            nxt = _stream.stage_aval(stage, walk_split, aval)
+        except Exception as exc:
+            first = str(exc).splitlines()[0] if str(exc) else ""
+            diags.append(Diagnostic(
+                "BLT001", idx,
+                "%s fails abstract tracing on input %s %s: %s%s"
+                % (label, tuple(aval.shape), np.dtype(aval.dtype),
+                   type(exc).__name__, ": " + first if first else ""),
+                hint="the stage would fail identically inside the "
+                     "per-slab program; fix the callable's shape/dtype "
+                     "contract"))
+            break
+        old, new = np.dtype(aval.dtype), np.dtype(nxt.dtype)
+        if new.itemsize > old.itemsize:
+            diags.append(Diagnostic(
+                "BLT003", idx,
+                "%s widens the pipeline dtype %s -> %s (every streamed "
+                "slab costs %dx its upload size on device)"
+                % (label, old, new, new.itemsize // old.itemsize),
+                hint="keep constants in the input dtype or cast back "
+                     "with map(dtype=...) if the widening is unintended"))
+        aval = nxt
+        stages.append(Stage(idx, label, aval.shape, np.dtype(aval.dtype),
+                            walk_split, _spec(mesh, aval.shape,
+                                              walk_split)))
+        idle_seen = _idle_device_check(mesh, aval.shape, walk_split, idx,
+                                       diags, idle_seen)
+    return Report(target + ", streaming (out-of-core)", stages, diags,
+                  dynamic=dynamic)
 
 
 def explain(obj):

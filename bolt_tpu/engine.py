@@ -91,6 +91,20 @@ _COUNTERS = {
     "diagnostics": 0,         # findings emitted by bolt_tpu.analysis.check
     "strict_checks": 0,       # pre-dispatch checks forced by analysis.strict
     "strict_rejections": 0,   # dispatches refused on error-severity findings
+    # host<->device traffic accounting (fed by bolt_tpu.stream.transfer —
+    # the ONE device_put wrapper, enforced by lint rule BLT105)
+    "transfer_bytes": 0,      # host bytes shipped to device
+    "transfer_seconds": 0.0,  # wall time inside counted transfers
+    # streaming-executor accounting (bolt_tpu.stream: the out-of-core
+    # double-buffered pipeline).  overlap_seconds is ingest time hidden
+    # behind device compute: max(0, ingest + compute - wall) per run;
+    # profile.overlap_efficiency() reports it as a fraction of ingest.
+    "stream_chunks": 0,           # slabs streamed through the executor
+    "stream_ingest_seconds": 0.0,  # prefetch-thread produce+upload time
+    "stream_compute_seconds": 0.0,  # main-thread per-slab compute time
+    "stream_wall_seconds": 0.0,    # end-to-end streamed-run wall time
+    "stream_overlap_seconds": 0.0,  # ingest hidden behind compute
+    "stream_prefetch_depth": 0,    # high-water configured prefetch depth
 }
 
 _MONITORING_HOOKED = False
@@ -310,6 +324,30 @@ def strict_checked():
 def strict_rejected():
     with _LOCK:
         _COUNTERS["strict_rejections"] += 1
+
+
+# ---------------------------------------------------------------------
+# transfer / streaming accounting (fed by bolt_tpu.stream)
+# ---------------------------------------------------------------------
+
+def record_transfer(nbytes, seconds):
+    """Tally one counted host->device transfer (bolt_tpu.stream.transfer
+    is the only caller — lint rule BLT105 keeps it that way)."""
+    with _LOCK:
+        _COUNTERS["transfer_bytes"] += int(nbytes)
+        _COUNTERS["transfer_seconds"] += seconds
+
+
+def record_stream(chunks, ingest_s, compute_s, wall_s, overlap_s, depth):
+    """Tally one completed streamed run (bolt_tpu.stream executor)."""
+    with _LOCK:
+        _COUNTERS["stream_chunks"] += int(chunks)
+        _COUNTERS["stream_ingest_seconds"] += ingest_s
+        _COUNTERS["stream_compute_seconds"] += compute_s
+        _COUNTERS["stream_wall_seconds"] += wall_s
+        _COUNTERS["stream_overlap_seconds"] += overlap_s
+        _COUNTERS["stream_prefetch_depth"] = max(
+            _COUNTERS["stream_prefetch_depth"], int(depth))
 
 
 # ---------------------------------------------------------------------

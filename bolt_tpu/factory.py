@@ -95,18 +95,34 @@ def rand(shape, context=None, axis=(0,), mode=None, dtype=None, seed=0):
                              seed=seed)
 
 
-def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None):
+def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None,
+                 chunks=None):
     """Build a bolt array by calling ``fn(index_slices) -> block`` per
-    shard — the sharded data-loader (extension beyond the reference
+    index range — the sharded data-loader (extension beyond the reference
     factory, whose ``sc.parallelize`` scatter needs the full array at the
-    driver).  ``mode='tpu'``: one call per device shard, each process
-    loading only its own devices' blocks; local mode: one call for the
-    whole array."""
+    driver).  ``mode='tpu'`` with an explicit ``dtype``: a LAZY streaming
+    source — reduction terminals stream it slab-by-slab through the
+    out-of-core executor (``bolt_tpu.stream``), other consumers
+    materialise one call per device shard; ``chunks`` sets records per
+    streamed slab.  Local mode: one call for the whole array."""
     cls = _lookup(context=context, mode=mode)
     if cls is ConstructLocal:
         return ConstructLocal.fromcallback(fn, shape, axis=axis, dtype=dtype)
     return ConstructTPU.fromcallback(fn, shape, context=context, axis=axis,
-                                     dtype=dtype)
+                                     dtype=dtype, chunks=chunks)
+
+
+def fromiter(blocks, shape, context=None, axis=(0,), mode=None, dtype=None):
+    """Build a bolt array from an ITERABLE of consecutive record blocks
+    (key-axes-first layout along the first key axis) — the sequential
+    streaming constructor for sources without random access.  ``dtype``
+    is required.  ``mode='tpu'``: a lazy streaming source like
+    :func:`fromcallback`; local mode assembles the blocks on host."""
+    cls = _lookup(context=context, mode=mode)
+    if cls is ConstructLocal:
+        return ConstructLocal.fromiter(blocks, shape, axis=axis, dtype=dtype)
+    return ConstructTPU.fromiter(blocks, shape, context=context, axis=axis,
+                                 dtype=dtype)
 
 
 def concatenate(arrays, axis=0, context=None, mode=None):

@@ -65,6 +65,27 @@ class ConstructLocal:
         return BoltArrayLocal(block)
 
     @staticmethod
+    def fromiter(blocks, shape, axis=(0,), dtype=None):
+        """Local analog of the streaming iterator constructor: blocks
+        (key-axes-first layout, concatenated along the first key axis)
+        are assembled into one host array.  ``dtype`` is required, like
+        the TPU backend (and ``np.fromiter``)."""
+        if dtype is None:
+            raise ValueError(
+                "fromiter requires an explicit dtype (blocks are consumed "
+                "lazily, so the element type cannot be inferred up front)")
+        from bolt_tpu.utils import inshape, iter_record_blocks, tupleize
+        shape = tuple(shape)
+        axes = sorted(tupleize(axis))
+        inshape(shape, axes)
+        rest = [i for i in range(len(shape)) if i not in axes]
+        shape = tuple(shape[i] for i in axes + rest)
+        out = np.empty(shape, dtype=dtype)
+        for lo, hi, block in iter_record_blocks(blocks, shape, dtype):
+            out[lo:hi] = block
+        return BoltArrayLocal(out)
+
+    @staticmethod
     def randn(shape, dtype=None, seed=0):
         """Standard-normal array (extension beyond the reference factory;
         RNG streams differ between backends by construction)."""

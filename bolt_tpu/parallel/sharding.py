@@ -161,10 +161,12 @@ def key_sharding(mesh, shape, split):
 def reshard(data, mesh, split):
     """Place ``data`` according to the key sharding for ``split``.
 
-    Outside jit this is ``jax.device_put`` (XLA inserts the collective —
+    Outside jit this is a ``device_put`` (XLA inserts the collective —
     all_to_all/all_gather — that the reference performs as a Spark shuffle;
-    SURVEY.md §2.5 lowering contract)."""
-    return jax.device_put(data, key_sharding(mesh, data.shape, split))
+    SURVEY.md §2.5 lowering contract), routed through the counted
+    transfer layer (``bolt_tpu.stream.transfer``, lint rule BLT105)."""
+    from bolt_tpu import stream
+    return stream.transfer(data, key_sharding(mesh, data.shape, split))
 
 
 def is_mesh(obj):

@@ -101,13 +101,15 @@ def instrument():
     The reference has nothing comparable in-repo (Spark UI fills the
     slot, SURVEY §5); this is the framework-level half of that story.
     """
+    import bolt_tpu.stream as _stream
     import bolt_tpu.tpu.array as _arr
     import bolt_tpu.tpu.chunk as _chunk
     import bolt_tpu.tpu.stack as _stack
     import bolt_tpu.tpu.stats as _stats
     # every module binds _cached_jit by name at import; snapshot and
     # restore EACH binding so nested/overlapping contexts unwind cleanly
-    saved = {m: m._cached_jit for m in (_arr, _chunk, _stack, _stats)}
+    saved = {m: m._cached_jit for m in (_arr, _chunk, _stack, _stats,
+                                        _stream)}
     orig = _arr._cached_jit
     stats = {}
 
@@ -176,6 +178,21 @@ def engine_counters():
 def reset_engine_counters():
     from bolt_tpu import engine
     engine.reset_counters()
+
+
+def overlap_efficiency(counters=None):
+    """Fraction of streaming ingest time (host production + upload)
+    hidden behind device compute, from the engine's ``stream_*``
+    counters: ``stream_overlap_seconds / stream_ingest_seconds`` where
+    per run ``overlap = max(0, ingest + compute − wall)``.  ``0.0`` when
+    nothing has streamed (or nothing overlapped); values toward ``1.0``
+    mean transfer is fully hidden — the out-of-core pipeline runs at
+    compute speed, not ingest speed."""
+    c = engine_counters() if counters is None else counters
+    ingest = c.get("stream_ingest_seconds", 0.0)
+    if not ingest:
+        return 0.0
+    return c.get("stream_overlap_seconds", 0.0) / ingest
 
 
 def engine_report(counters=None):

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from bolt_tpu import engine as _engine
+from bolt_tpu import stream as _streamlib
 from bolt_tpu.base import BoltArray, HostFallbackWarning
 from bolt_tpu.parallel.sharding import key_sharding
 from bolt_tpu.utils import (argpack, check_value_shape as _check_value_shape,
@@ -91,6 +92,19 @@ def _round_fn(decimals):
     def f(v):
         return jnp.round(v, decimals)
     f.__name__ = "round_%d" % decimals
+    return f
+
+
+@lru_cache(maxsize=64)
+def _cast_fn(dtype):
+    """Stable per-dtype cast callable (streamed ``map(dtype=...)``
+    records it as a stage; a fresh lambda per call would defeat the
+    per-slab executable cache)."""
+    dt = np.dtype(dtype)
+
+    def f(v):
+        return v.astype(dt)
+    f.__name__ = "astype_%s" % dt
     return f
 
 # toarray's batched pending-filter fetch ships the FULL padded buffer to
@@ -360,13 +374,13 @@ def _complex_safe_put(a, sharding=None):
     ``sharding`` when given."""
     a = np.asarray(a)
     if not np.issubdtype(a.dtype, np.complexfloating):
-        return (jax.device_put(a, sharding) if sharding is not None
+        return (_streamlib.transfer(a, sharding) if sharding is not None
                 else jnp.asarray(a))
     re = np.ascontiguousarray(a.real)
     im = np.ascontiguousarray(a.imag)
     if sharding is not None:
-        dre = jax.device_put(re, sharding)
-        dim = jax.device_put(im, sharding)
+        dre = _streamlib.transfer(re, sharding)
+        dim = _streamlib.transfer(im, sharding)
     else:
         dre, dim = jnp.asarray(re), jnp.asarray(im)
 
@@ -477,6 +491,10 @@ class BoltArrayTPU(BoltArray):
         # (see filter / _fused_filter_stat); any other consumer resolves
         # it into the _pending compaction form first
         self._fpending = None
+        # lazy out-of-core stream source (bolt_tpu/stream.py): no device
+        # data exists yet; reduction terminals run the double-buffered
+        # streaming executor, everything else materialises via ._data
+        self._stream = None
         self._donated = False
         self._aval = None if data is None else jax.ShapeDtypeStruct(
             data.shape, data.dtype)
@@ -488,12 +506,32 @@ class BoltArrayTPU(BoltArray):
         b._aval = aval
         return b
 
+    @classmethod
+    def _streamed(cls, source):
+        """Wrap a lazy out-of-core :class:`bolt_tpu.stream.StreamSource`:
+        shape/dtype answer abstractly from the recorded stage chain, the
+        streaming terminals (``sum``/``mean``/``var``/``std``/``reduce``)
+        run the double-buffered executor, and any other consumer
+        materialises transparently through ``._data`` (per-shard callback
+        upload + the standard deferred/chunked/stacked programs)."""
+        st = _streamlib.result_state(source)
+        b = cls(None, st.split, source.mesh)
+        b._stream = source
+        b._aval = None if st.dynamic else jax.ShapeDtypeStruct(
+            tuple(st.shape), st.dtype)
+        return b
+
     # ------------------------------------------------------------------
     # properties (reference: ``BoltArraySpark`` properties, SURVEY §2.2)
     # ------------------------------------------------------------------
 
     @property
     def shape(self):
+        if self._stream is not None and self._aval is None:
+            # a streamed filter: the survivor count is unknowable
+            # without running the pipeline — materialise (mirrors the
+            # pending-filter count sync)
+            self._data
         if self._fpending is not None:
             self._resolve_fpending()
         if self._pending is not None:
@@ -508,6 +546,9 @@ class BoltArrayTPU(BoltArray):
 
     @property
     def dtype(self):
+        if self._stream is not None and self._aval is None:
+            # dtype is known abstractly even for a streamed filter
+            return np.dtype(_streamlib.result_state(self._stream).dtype)
         if self._fpending is not None:
             # dtype is known without dispatching the filter program
             return np.dtype(self._fpending[6])
@@ -532,6 +573,14 @@ class BoltArrayTPU(BoltArray):
         """True while this array is an unmaterialised map chain (the
         analog of an RDD transformation not yet executed)."""
         return self._concrete is None and self._chain is not None
+
+    @property
+    def streaming(self):
+        """True while this array is a lazy out-of-core stream source
+        (``fromcallback``/``fromiter``): nothing is resident on device;
+        reduction terminals stream it slab-by-slab, other consumers
+        materialise it (which requires the full array to fit)."""
+        return self._stream is not None
 
     @property
     def pending(self):
@@ -645,6 +694,19 @@ class BoltArrayTPU(BoltArray):
         """The concrete sharded ``jax.Array``; materialises a deferred
         chain on first access (one fused compiled program)."""
         self._guard_donated()
+        if self._stream is not None:
+            # materialise the lazy out-of-core source through the
+            # STANDARD machinery (stream.materialize replays every
+            # recorded stage via the normal deferred/chunked/stacked
+            # programs), then adopt the result
+            source = self._stream
+            self._stream = None
+            out = _streamlib.materialize(source)
+            data = out._data            # resolves deferred/pending state
+            self._concrete = data
+            self._split = out._split
+            self._aval = jax.ShapeDtypeStruct(data.shape, data.dtype)
+            return _check_live(self._concrete)
         if self._fpending is not None:
             self._resolve_fpending()
         if self._pending is not None:
@@ -774,6 +836,17 @@ class BoltArrayTPU(BoltArray):
         full_aval = jax.ShapeDtypeStruct(kshape + tuple(out_aval.shape),
                                          out_aval.dtype)
 
+        if aligned._stream is not None and not with_keys:
+            # streaming source (out-of-core): record the map as a
+            # device-side stage — it fuses into the per-slab program.
+            # (with_keys maps need GLOBAL key indices, which a slab-local
+            # program cannot produce; they materialise below.)
+            out = _streamlib.map_stage(aligned, func)
+            if dtype is not None and np.dtype(dtype) != np.dtype(
+                    full_aval.dtype):
+                out = _streamlib.map_stage(out, _cast_fn(_canon(dtype)))
+            return out
+
         # defer: extend the chain (or start one) without executing —
         # with_keys maps defer too (as _WithKeysFunc entries), so
         # map(f, with_keys=True).sum() is ONE fused program like any
@@ -830,15 +903,21 @@ class BoltArrayTPU(BoltArray):
             # non-traceable predicate: host fallback through the local oracle
             _warn_fallback("filter", func, exc)
             out = aligned.tolocal().filter(func, axis=tuple(range(split)))
-            data = jax.device_put(
-                jnp.asarray(np.asarray(out)),
-                key_sharding(mesh, out.shape, 1))
+            data = _streamlib.transfer(
+                np.asarray(out), key_sharding(mesh, out.shape, 1))
             return self._wrap(data, 1)
         if prod(getattr(pred_aval, "shape", ())) != 1:
             raise ValueError(
                 "filter predicate must return a scalar truth value per "
                 "record; got shape %s for value shape %s"
                 % (tuple(pred_aval.shape), vshape))
+
+        if aligned._stream is not None:
+            # streaming source: the predicate stays lazy (a trailing
+            # stream stage); reduction terminals fold its mask into the
+            # per-slab pass — out-of-core filter(...).sum() never
+            # materialises anything input-sized
+            return _streamlib.filter_stage(aligned, func)
 
         nbytes = n * prod(vshape) * np.dtype(aligned._aval.dtype).itemsize
         if nbytes > _FILTER_FUSED_MAX_BYTES:
@@ -923,6 +1002,13 @@ class BoltArrayTPU(BoltArray):
             if out is not NotImplemented:
                 return out
         axes = sorted(tupleize(axis))
+        if self._stream is not None:
+            # lazy out-of-core source: stream the pairwise tree (per-slab
+            # trees, cross-slab pairwise merges — fold order follows slab
+            # boundaries, like the reference's treeReduce)
+            out = _streamlib.maybe_reduce(self, func, tuple(axes), keepdims)
+            if out is not NotImplemented:
+                return out
         aligned = self._align(axes)
         split = aligned._split
         kshape = aligned.shape[:split]
@@ -944,9 +1030,8 @@ class BoltArrayTPU(BoltArray):
             _warn_fallback("reduce", func, exc)
             out = aligned.tolocal().reduce(
                 func, axis=tuple(range(split)), keepdims=keepdims)
-            data = jax.device_put(
-                jnp.asarray(np.asarray(out)),
-                key_sharding(mesh, out.shape, new_split))
+            data = _streamlib.transfer(
+                np.asarray(out), key_sharding(mesh, out.shape, new_split))
             return self._wrap(data, new_split)
 
         # donation-aware terminal: consuming a sole-owned deferred chain
@@ -990,6 +1075,13 @@ class BoltArrayTPU(BoltArray):
 
     def _stat(self, axis, name, keepdims=False, ddof=None):
         _engine.strict_guard(self, "%s()" % name)
+        if self._stream is not None:
+            # lazy out-of-core source: run the reduction as a streamed
+            # double-buffered pipeline when the geometry allows (all key
+            # axes, no keepdims); anything else materialises below
+            out = _streamlib.maybe_stat(self, axis, name, keepdims, ddof)
+            if out is not NotImplemented:
+                return out
         if self._fpending is not None:
             # an unmaterialised filter feeding a reduction: fold the
             # predicate mask straight into the reduce — ONE fused HBM
@@ -3005,6 +3097,10 @@ class BoltArrayTPU(BoltArray):
         b._chain = self._chain
         b._pending = self._pending
         b._fpending = self._fpending
+        # a lazy stream source is shared, not forked: callback sources
+        # re-stream on demand, and either wrapper materialising adopts
+        # its own concrete state without touching the other
+        b._stream = self._stream
         b._donated = self._donated
         b._aval = self._aval
         return b

@@ -86,7 +86,8 @@ class ConstructTPU:
                 target = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
                 if target != data.dtype:
                     data = data.astype(target)
-            data = jax.device_put(
+            from bolt_tpu import stream as _streamlib
+            data = _streamlib.transfer(
                 data, key_sharding(mesh, data.shape, split))
             return BoltArrayTPU(data, split, mesh)
 
@@ -194,20 +195,32 @@ class ConstructTPU:
         return BoltArrayTPU(fn(jnp.uint32(seed % (1 << 32))), split, mesh)
 
     @staticmethod
-    def fromcallback(fn, shape, context=None, axis=(0,), dtype=None):
-        """Build a distributed array by calling ``fn`` once per device
-        shard — the sharded data-loader slot.
+    def fromcallback(fn, shape, context=None, axis=(0,), dtype=None,
+                     chunks=None):
+        """Build a distributed array by calling ``fn`` per index range —
+        the sharded data-loader slot.
 
         ``fn(index)`` receives a tuple of per-axis ``slice`` objects
-        covering one shard of the KEY-AXES-FIRST logical ``shape`` and
+        covering one range of the KEY-AXES-FIRST logical ``shape`` and
         returns that block (anything ``np.asarray`` accepts: a memmap
-        read, an HDF5/zarr slice, a computed tile).  Each process loads
-        only its own devices' shards, so an array larger than any single
-        host's RAM streams straight from storage onto the mesh.  The
-        reference's analog is the driver-side ``sc.parallelize`` scatter
+        read, an HDF5/zarr slice, a computed tile).  The reference's
+        analog is the driver-side ``sc.parallelize`` scatter
         (``bolt/spark/construct.py :: ConstructSpark.array``), which
         must materialise the full array at the driver first; here no
         full copy ever exists anywhere.
+
+        With an EXPLICIT ``dtype`` (single-process) the result is a LAZY
+        STREAMING source (ISSUE 3): nothing is produced or uploaded at
+        construction.  Reduction terminals — directly or through a
+        ``chunk()``/``stacked()`` view — stream the data slab-by-slab
+        through the double-buffered out-of-core executor
+        (:mod:`bolt_tpu.stream`), so datasets LARGER than device memory
+        reduce in one pass; any other consumer materialises it with one
+        callback call per device shard, exactly as before.  ``chunks``
+        sets the records per streamed slab (default: a
+        ``BOLT_STREAM_SLAB_BYTES`` budget, 64 MB).  ``dtype=None`` means
+        "whatever the callback produces" and stays eager (the element
+        type cannot be known without calling the loader).
 
         Note ``shape`` is interpreted key-axes-first (like
         ``ones``/``zeros``): ``axis`` names which of those axes are
@@ -217,6 +230,15 @@ class ConstructTPU:
         explicit = dtype is not None
         mesh, shape, split, dtype, sharding = \
             ConstructTPU._device_build_spec(shape, context, axis, dtype)
+        multihost = any(d.process_index != jax.process_index()
+                        for d in np.asarray(mesh.devices).flat)
+        if explicit and not multihost:
+            # lazy streaming source; materialisation (stream.materialize)
+            # replays the per-shard upload below bit-identically
+            from bolt_tpu import stream as _streamlib
+            src = _streamlib.StreamSource.from_callback(
+                fn, shape, split, dtype, mesh, chunks=chunks)
+            return BoltArrayTPU._streamed(src)
         # dtype=None means "whatever the callback produces" (the loader
         # knows its storage dtype); an explicit dtype converts each block
         dtype = dtype if explicit else None
@@ -231,8 +253,48 @@ class ConstructTPU:
                     "(expected %s)" % (block.shape, index, want))
             return block
 
+        import time as _time
+        t0 = _time.perf_counter()
         data = jax.make_array_from_callback(shape, sharding, produce)
+        from bolt_tpu import engine as _engine
+        _engine.record_transfer(data.nbytes, _time.perf_counter() - t0)
         return BoltArrayTPU(data, split, mesh)
+
+    @staticmethod
+    def fromiter(blocks, shape, context=None, axis=(0,), dtype=None):
+        """Lazy streaming construction from an ITERABLE of consecutive
+        record blocks — the sequential twin of :meth:`fromcallback` for
+        sources that cannot random-access (a decompression stream, a
+        database cursor, a generator).
+
+        ``blocks`` yields arrays in KEY-AXES-FIRST layout, concatenated
+        along the first key axis; together they must cover ``shape``
+        exactly.  ``dtype`` is REQUIRED (``np.fromiter`` precedent —
+        blocks are consumed lazily, so the element type cannot be
+        inferred up front).  Reduction terminals stream the iterator
+        once through the out-of-core executor; materialising consumers
+        assemble it on host first (needs host RAM for the full array).
+        """
+        from bolt_tpu.tpu.array import BoltArrayTPU
+        if dtype is None:
+            raise ValueError(
+                "fromiter requires an explicit dtype (blocks are consumed "
+                "lazily, so the element type cannot be inferred up front)")
+        mesh, shape, split, dtype, _ = \
+            ConstructTPU._device_build_spec(shape, context, axis, dtype)
+        if any(d.process_index != jax.process_index()
+               for d in np.asarray(mesh.devices).flat):
+            # a sequential host iterator cannot serve per-process shards
+            # (fromcallback's multihost path random-accesses by index)
+            raise ValueError(
+                "fromiter does not support multi-host meshes: blocks are "
+                "a sequential stream on ONE host; use fromcallback, whose "
+                "loader serves any index range, so each process can read "
+                "its own devices' shards")
+        from bolt_tpu import stream as _streamlib
+        src = _streamlib.StreamSource.from_iter(blocks, shape, split,
+                                                dtype, mesh)
+        return BoltArrayTPU._streamed(src)
 
     @staticmethod
     def randn(shape, context=None, axis=(0,), dtype=None, seed=0):

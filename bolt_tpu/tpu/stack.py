@@ -17,11 +17,57 @@ import jax
 import jax.numpy as jnp
 
 from bolt_tpu import engine as _engine
+from bolt_tpu import stream as _streamlib
 from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
                                 _canon, _chain_apply, _chain_donate_ok,
                                 _check_live, _check_value_shape, _constrain,
                                 _traceable)
 from bolt_tpu.utils import prod
+
+
+def _stack_map_body(data, func, split, size, canon=None):
+    """The block-batched map program body: flatten records, vmap ``func``
+    over full-size blocks plus one ragged tail, restore keys, optionally
+    cast.  Geometry derives from ``data.shape``, so the SAME traced body
+    serves the materialised program below AND the streaming executor's
+    per-slab program (``bolt_tpu/stream.py``) — parity by construction."""
+    kshape = data.shape[:split]
+    vshape = data.shape[split:]
+    n = prod(kshape)
+    flat = data.reshape((n,) + vshape)
+    if n == 0:
+        # zero records (a filter with no survivors): func never runs,
+        # but the empty output must still carry the value shape/dtype
+        # func WOULD produce so empty and non-empty branches of one
+        # pipeline stay consistent
+        ob = jax.eval_shape(func, jax.ShapeDtypeStruct(
+            (size,) + vshape, flat.dtype))
+        return jnp.zeros(kshape + tuple(ob.shape[1:]), canon or ob.dtype)
+    nfull = n // size
+    outs = []
+    if nfull:
+        blocks = flat[:nfull * size].reshape((nfull, size) + vshape)
+        out = jax.vmap(func)(blocks)
+        if out.ndim < 2 or out.shape[:2] != (nfull, size):
+            got = out.shape[1] if out.ndim >= 2 else "none"
+            raise ValueError(
+                "stacked map must preserve the record count: "
+                "block of %d records -> %s" % (size, got))
+        outs.append(out.reshape((nfull * size,) + out.shape[2:]))
+    if n % size:
+        tail = flat[nfull * size:]
+        tout = func(tail)
+        if tout.shape[0] != tail.shape[0]:
+            raise ValueError(
+                "stacked map must preserve the record count: "
+                "block of %d records -> %d"
+                % (tail.shape[0], tout.shape[0]))
+        outs.append(tout)
+    out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+    out = out.reshape(kshape + out.shape[1:])
+    if canon is not None:
+        out = out.astype(canon)   # fused into the same program
+    return out
 
 
 class StackedArray:
@@ -74,6 +120,13 @@ class StackedArray:
         func = _traceable(func)
         b = self._barray
         _engine.strict_guard(b, "stacked().map()")
+        if b._stream is not None:
+            # streaming source (out-of-core): record the block-batched
+            # map as a device-side stage; the per-slab program applies
+            # the SAME _stack_map_body at slab geometry
+            out = _streamlib.stacked_map_stage(self, func, dtype)
+            if out is not NotImplemented:
+                return out
         split = b.split
         mesh = b.mesh
         kshape = b.shape[:split]

@@ -261,6 +261,35 @@ def chunk_align(vshape, axis, size, padding):
     return axes, size, padding
 
 
+def iter_record_blocks(blocks, shape, dtype):
+    """Yield ``(lo, hi, block)`` from an iterable of consecutive record
+    blocks (key-axes-first layout, concatenated along the first axis),
+    each validated against ``shape`` and cast to ``dtype``; together the
+    blocks must cover ``shape`` exactly.  The ONE ``fromiter`` block
+    contract, shared by the local backend and the streaming executor so
+    their error behavior cannot drift."""
+    n = shape[0]
+    rest = tuple(shape[1:])
+    lo = 0
+    for block in iter(blocks):
+        block = np.asarray(block, dtype=dtype)
+        if block.ndim != len(shape) or block.shape[1:] != rest:
+            raise ValueError(
+                "fromiter block has shape %s; expected (k,) + %s"
+                % (block.shape, rest))
+        hi = lo + block.shape[0]
+        if hi > n:
+            raise ValueError(
+                "fromiter blocks overrun the declared shape: %d of %d "
+                "records already consumed" % (hi, n))
+        yield lo, hi, block
+        lo = hi
+    if lo != n:
+        raise ValueError(
+            "fromiter blocks cover only %d of %d declared records"
+            % (lo, n))
+
+
 def check_value_shape(hint, inferred):
     """Validate an explicit ``value_shape`` hint against the inferred
     per-record output shape (shared by every backend's array/chunked/
