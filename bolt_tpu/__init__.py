@@ -28,8 +28,8 @@ __all__ = ["array", "ones", "zeros", "full", "rand", "randn",
            "precision", "BoltArray", "BoltArrayLocal", "BoltArrayTPU",
            "HostFallbackWarning", "__version__"]
 
-_SUBMODULES = ("analysis", "checkpoint", "engine", "profile", "parallel",
-               "ops", "statcounter", "stream", "utils")
+_SUBMODULES = ("analysis", "checkpoint", "engine", "obs", "profile",
+               "parallel", "ops", "statcounter", "stream", "utils")
 
 
 def __getattr__(name):

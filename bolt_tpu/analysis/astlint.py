@@ -27,6 +27,12 @@ Rules (the ``BLT1xx`` range; the abstract pipeline checker owns
   Reads must go through ``._data``, which runs the ``_guard_donated``
   donation gate; a direct ``._concrete`` read can hand out a buffer a
   donating terminal already consumed.
+* **BLT106** — no raw ``time.perf_counter()`` bookkeeping outside
+  ``obs/`` and ``profile.py``.  Durations must come from
+  ``bolt_tpu.obs`` (``obs.clock`` for counters, ``obs.span`` for
+  timeline intervals) so every timing in the package shares one clock
+  and lands on one exportable timeline instead of in scattered private
+  stopwatches.
 
 A finding on line *N* is suppressed when that line carries a
 ``# lint: allow(BLT1xx <reason>)`` pragma — the escape hatch for the
@@ -46,9 +52,12 @@ RULES = {
     "BLT103": "precision= literal bypassing _precision.resolve()",
     "BLT104": "._concrete access bypassing the _guard_donated gate",
     "BLT105": "raw jax.device_put outside the stream transfer layer",
+    "BLT106": "raw time.perf_counter bookkeeping outside bolt_tpu.obs",
 }
 
-# rule -> path suffixes (os-normalised) exempt from it
+# rule -> path suffixes (os-normalised) exempt from it; an entry ending
+# with the path separator exempts every file under a directory of that
+# name (e.g. the whole obs/ subsystem)
 _EXEMPT = {
     "BLT101": ("engine.py",),
     "BLT102": ("_compat.py",),
@@ -56,6 +65,8 @@ _EXEMPT = {
     "BLT104": (os.path.join("tpu", "array.py"),),
     # stream.transfer IS the counted device_put wrapper
     "BLT105": ("stream.py",),
+    # obs owns the clock; profile.py is the user-facing timing facade
+    "BLT106": ("obs" + os.sep, "profile.py"),
 }
 
 _VERSION_SENSITIVE = {
@@ -111,10 +122,18 @@ def _dotted(node):
 def _exempt(code, path):
     """Suffix match ANCHORED on a path separator: ``upstream.py`` must
     not inherit ``stream.py``'s exemption (nor ``myengine.py``
-    ``engine.py``'s)."""
+    ``engine.py``'s).  Directory entries (trailing separator) exempt any
+    file under a component of that exact name — ``obs/`` matches
+    ``bolt_tpu/obs/trace.py`` but not ``jobs/trace.py``."""
     norm = os.path.normpath(path)
-    return any(norm == suffix or norm.endswith(os.sep + suffix)
-               for suffix in _EXEMPT[code])
+    for suffix in _EXEMPT[code]:
+        if suffix.endswith(os.sep):
+            if (os.sep + suffix) in (os.sep + norm) \
+                    or norm.startswith(suffix):
+                return True
+        elif norm == suffix or norm.endswith(os.sep + suffix):
+            return True
+    return False
 
 
 def _builder_regions(tree):
@@ -195,11 +214,15 @@ def lint_source(src, path="<string>"):
 
     builder_spans = _builder_regions(tree)
 
-    # import aliases: local name -> dotted origin ("from jax import jit")
+    # import aliases: local name -> dotted origin ("from jax import jit"
+    # AND "import time as _time" — renamed plain imports must not dodge
+    # the chain rules)
     aliases = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
                 if a.name.startswith("jax.experimental.shard_map"):
                     emit("BLT102", node,
                          "import of jax.experimental.shard_map; route it "
@@ -292,6 +315,15 @@ def lint_source(src, path="<string>"):
                  "raw jax.device_put bypasses the counted transfer layer "
                  "(transfer_bytes/transfer_seconds stay blind); route it "
                  "through bolt_tpu.stream.transfer")
+
+        # ---- BLT106: raw perf_counter bookkeeping outside obs ----------
+        if isinstance(node, ast.Call) \
+                and resolved(node.func) == "time.perf_counter":
+            emit("BLT106", node,
+                 "raw time.perf_counter() keeps its timing off the shared "
+                 "clock and the obs timeline; use bolt_tpu.obs.clock() "
+                 "for counter bookkeeping or obs.span(...) for a traced "
+                 "interval")
 
     findings.sort(key=lambda f: (f.line, f.col))
     return findings

@@ -36,6 +36,7 @@ import numpy as np
 
 import jax
 
+from bolt_tpu.obs import trace as _obs
 from bolt_tpu.analysis.diagnostics import Diagnostic, Report, Stage
 from bolt_tpu.parallel.sharding import key_spec, spec_names
 from bolt_tpu.utils import prod
@@ -153,7 +154,19 @@ def check(obj):
     (checked through its underlying array), or a local array (trivial
     report).  Never compiles, dispatches, syncs a survivor count or
     resolves deferred state — ``engine.counters()`` is unchanged except
-    for the ``diagnostics`` tally this check feeds."""
+    for the ``diagnostics`` tally this check feeds.  Each check records
+    an ``analysis.check`` span on the obs timeline (attributes: finding
+    count, dynamic flag) — under ``analysis.strict()`` those spans sit
+    inside the terminal's dispatch span, making the gate's cost
+    visible."""
+    with _obs.span("analysis.check") as sp:
+        rep = _check_impl(obj)
+        sp.set(diagnostics=len(rep.diagnostics),
+               dynamic=bool(getattr(rep, "dynamic", False)))
+        return rep
+
+
+def _check_impl(obj):
     from bolt_tpu import engine
     from bolt_tpu.tpu.array import BoltArrayTPU
 

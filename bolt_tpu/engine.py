@@ -45,10 +45,13 @@ references, so cached entries pin no device memory.
 import contextlib
 import os
 import threading
-import time
 from collections import OrderedDict
 
 import jax
+
+from bolt_tpu.obs import metrics as _metrics
+from bolt_tpu.obs import trace as _obs
+from bolt_tpu.obs.trace import clock as _clock
 
 # ---------------------------------------------------------------------
 # configuration
@@ -70,10 +73,15 @@ _AOT = os.environ.get("BOLT_ENGINE_AOT", "1").lower() not in ("0", "false")
 _DONATE_MIN_BYTES = int(os.environ.get("BOLT_DONATE_MIN_BYTES",
                                        str(64 << 20)))
 
-_LOCK = threading.RLock()
+_LOCK = threading.RLock()            # guards the executable cache
 _CACHE = OrderedDict()               # key -> _Entry
 
-_COUNTERS = {
+# The engine counters live in the bolt_tpu.obs.metrics registry as the
+# counter group named "engine" (PR 4): same keys, same int/float types,
+# same lock-consistent snapshots as the module dict they replace —
+# profile.engine_counters() is unchanged — but now enumerable (and
+# resettable) alongside every other metric via obs.registry().
+_SCHEMA = {
     "hits": 0,                # get() found the key
     "misses": 0,              # get() built a new entry (builder ran)
     "aot_compiles": 0,        # explicit lower+compile runs
@@ -107,6 +115,17 @@ _COUNTERS = {
     "stream_prefetch_depth": 0,    # high-water configured prefetch depth
 }
 
+_COUNTERS = _metrics.registry().group("engine", _SCHEMA)
+
+# latency/size distributions riding on the same registry lock: the
+# counters above give totals, these give shape (log2 buckets — see
+# bolt_tpu.obs.metrics.Histogram).  The ".hist" suffix keeps them off
+# the group's flattened "engine.<key>" snapshot namespace.
+_DISPATCH_HIST = _metrics.registry().histogram(
+    "engine.dispatch_seconds.hist", lo=-20, hi=8)
+_TRANSFER_HIST = _metrics.registry().histogram(
+    "engine.transfer_bytes.hist", lo=6, hi=36)
+
 _MONITORING_HOOKED = False
 
 
@@ -121,11 +140,9 @@ def _hook_persistent_monitoring():
 
         def listen(event, **kwargs):
             if event == "/jax/compilation_cache/cache_hits":
-                with _LOCK:
-                    _COUNTERS["persistent_hits"] += 1
+                _COUNTERS.add("persistent_hits")
             elif event == "/jax/compilation_cache/cache_misses":
-                with _LOCK:
-                    _COUNTERS["persistent_misses"] += 1
+                _COUNTERS.add("persistent_misses")
 
         monitoring.register_event_listener(listen)
         _MONITORING_HOOKED = True
@@ -135,19 +152,18 @@ def _hook_persistent_monitoring():
 
 def counters():
     """A CONSISTENT snapshot dict of the engine counters: the copy is
-    taken under the engine lock — the same lock every increment holds —
-    so a snapshot can never interleave with a half-applied update (e.g.
-    ``aot_compiles`` bumped but its ``compile_seconds`` not yet).
-    Counters are monotonic within a process; :func:`reset_counters`
-    zeroes them."""
-    with _LOCK:
-        return dict(_COUNTERS)
+    taken under the metrics-registry lock — the same lock every
+    increment holds — so a snapshot can never interleave with a
+    half-applied update (e.g. ``aot_compiles`` bumped but its
+    ``compile_seconds`` not yet).  Counters are monotonic within a
+    process; :func:`reset_counters` zeroes them.  The backing store is
+    the ``"engine"`` counter group in ``bolt_tpu.obs.registry()`` —
+    keys, types and semantics are identical to the pre-registry dict."""
+    return _COUNTERS.snapshot()
 
 
 def reset_counters():
-    with _LOCK:
-        for k in _COUNTERS:
-            _COUNTERS[k] = 0 if isinstance(_COUNTERS[k], int) else 0.0
+    _COUNTERS.reset()
 
 
 def clear():
@@ -273,9 +289,11 @@ def donation(min_bytes):
 
 
 def donation_granted():
-    """Count a granted terminal donation (called by the op layers)."""
-    with _LOCK:
-        _COUNTERS["donations"] += 1
+    """Count a granted terminal donation (called by the op layers); a
+    timeline carries it as an instant ``engine.donate`` mark under the
+    consuming terminal's span."""
+    _COUNTERS.add("donations")
+    _obs.event("engine.donate")
 
 
 # ---------------------------------------------------------------------
@@ -312,18 +330,16 @@ def strict_guard(arr, op):
 def record_diagnostics(n):
     """Tally ``n`` checker findings (fed by ``bolt_tpu.analysis.check``)."""
     if n:
-        with _LOCK:
-            _COUNTERS["diagnostics"] += n
+        _COUNTERS.add("diagnostics", n)
 
 
 def strict_checked():
-    with _LOCK:
-        _COUNTERS["strict_checks"] += 1
+    _COUNTERS.add("strict_checks")
 
 
 def strict_rejected():
-    with _LOCK:
-        _COUNTERS["strict_rejections"] += 1
+    _COUNTERS.add("strict_rejections")
+    _obs.event("engine.strict_reject")
 
 
 # ---------------------------------------------------------------------
@@ -333,21 +349,21 @@ def strict_rejected():
 def record_transfer(nbytes, seconds):
     """Tally one counted host->device transfer (bolt_tpu.stream.transfer
     is the only caller — lint rule BLT105 keeps it that way)."""
-    with _LOCK:
-        _COUNTERS["transfer_bytes"] += int(nbytes)
-        _COUNTERS["transfer_seconds"] += seconds
+    _COUNTERS.update(transfer_bytes=int(nbytes),
+                     transfer_seconds=seconds)
+    _TRANSFER_HIST.observe(int(nbytes))
 
 
 def record_stream(chunks, ingest_s, compute_s, wall_s, overlap_s, depth):
-    """Tally one completed streamed run (bolt_tpu.stream executor)."""
-    with _LOCK:
-        _COUNTERS["stream_chunks"] += int(chunks)
-        _COUNTERS["stream_ingest_seconds"] += ingest_s
-        _COUNTERS["stream_compute_seconds"] += compute_s
-        _COUNTERS["stream_wall_seconds"] += wall_s
-        _COUNTERS["stream_overlap_seconds"] += overlap_s
-        _COUNTERS["stream_prefetch_depth"] = max(
-            _COUNTERS["stream_prefetch_depth"], int(depth))
+    """Tally one completed streamed run (bolt_tpu.stream executor); the
+    six keys apply atomically — a snapshot can never see a run's wall
+    time without its overlap."""
+    _COUNTERS.update(_maxima={"stream_prefetch_depth": int(depth)},
+                     stream_chunks=int(chunks),
+                     stream_ingest_seconds=ingest_s,
+                     stream_compute_seconds=compute_s,
+                     stream_wall_seconds=wall_s,
+                     stream_overlap_seconds=overlap_s)
 
 
 # ---------------------------------------------------------------------
@@ -387,19 +403,20 @@ class _Dispatch:
         return self.jitted.lower(*args, **kwargs)
 
     def __call__(self, *args):
-        t0 = time.perf_counter()
+        sp = _obs.begin("engine.dispatch")
+        t0 = _clock()
         try:
             out = self._dispatch(args)
         finally:
-            with _LOCK:
-                _COUNTERS["dispatches"] += 1
-                _COUNTERS["dispatch_seconds"] += time.perf_counter() - t0
+            dt = _clock() - t0
+            _COUNTERS.update(dispatches=1, dispatch_seconds=dt)
+            _DISPATCH_HIST.observe(dt)
+            _obs.end(sp)
         return out
 
     def _dispatch(self, args):
         if not _AOT:
-            with _LOCK:
-                _COUNTERS["fallbacks"] += 1
+            _COUNTERS.add("fallbacks")
             return self.jitted(*args)
         try:
             leaves, treedef = jax.tree_util.tree_flatten(args)
@@ -410,15 +427,22 @@ class _Dispatch:
             fn = self.compiled.get(sig)
             if fn is None:
                 try:
-                    t0 = time.perf_counter()
-                    lowered = self.jitted.lower(*args)
-                    t1 = time.perf_counter()
-                    fn = lowered.compile()
-                    t2 = time.perf_counter()
-                    with _LOCK:
-                        _COUNTERS["aot_compiles"] += 1
-                        _COUNTERS["lower_seconds"] += t1 - t0
-                        _COUNTERS["compile_seconds"] += t2 - t1
+                    lsp = _obs.begin("engine.lower")
+                    try:
+                        t0 = _clock()
+                        lowered = self.jitted.lower(*args)
+                        t1 = _clock()
+                    finally:
+                        _obs.end(lsp)
+                    csp = _obs.begin("engine.compile")
+                    try:
+                        fn = lowered.compile()
+                        t2 = _clock()
+                    finally:
+                        _obs.end(csp)
+                    _COUNTERS.update(aot_compiles=1,
+                                     lower_seconds=t1 - t0,
+                                     compile_seconds=t2 - t1)
                     self.compiled[sig] = fn
                 except Exception:
                     fn = None
@@ -434,8 +458,7 @@ class _Dispatch:
                     # asserts) propagate — re-running them would double
                     # work and bury the real error.
                     pass
-        with _LOCK:
-            _COUNTERS["fallbacks"] += 1
+        _COUNTERS.add("fallbacks")
         return self.jitted(*args)
 
 
@@ -453,12 +476,18 @@ def get(key, builder):
     with _LOCK:
         entry = _CACHE.get(key)
         if entry is not None:
-            _COUNTERS["hits"] += 1
+            _COUNTERS.add("hits")
             _CACHE.move_to_end(key)
             return entry
-        _COUNTERS["misses"] += 1
+    _COUNTERS.add("misses")
     # build OUTSIDE the lock: builders may trace (slow) and re-enter
-    entry = _Dispatch(builder())
+    sp = _obs.begin("engine.build")
+    if sp is not None and isinstance(key, tuple) and key:
+        sp.set(family=str(key[0]))
+    try:
+        entry = _Dispatch(builder())
+    finally:
+        _obs.end(sp)
     with _LOCK:
         # a concurrent miss may have built and inserted first; keep the
         # WINNER (it may already hold compiled executables) and discard

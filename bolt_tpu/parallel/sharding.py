@@ -164,9 +164,15 @@ def reshard(data, mesh, split):
     Outside jit this is a ``device_put`` (XLA inserts the collective —
     all_to_all/all_gather — that the reference performs as a Spark shuffle;
     SURVEY.md §2.5 lowering contract), routed through the counted
-    transfer layer (``bolt_tpu.stream.transfer``, lint rule BLT105)."""
+    transfer layer (``bolt_tpu.stream.transfer``, lint rule BLT105) and
+    recorded as a ``sharding.reshard`` span on the obs timeline (host
+    uploads nest a ``stream.transfer`` child; device-side resharding is
+    the ICI exchange the span's duration bounds)."""
     from bolt_tpu import stream
-    return stream.transfer(data, key_sharding(mesh, data.shape, split))
+    from bolt_tpu.obs import trace as _obs
+    with _obs.span("sharding.reshard", split=split,
+                   bytes=int(getattr(data, "nbytes", 0))):
+        return stream.transfer(data, key_sharding(mesh, data.shape, split))
 
 
 def is_mesh(obj):

@@ -45,17 +45,27 @@ def annotate(name):
 def timeit(fn, iters=5, warmup=1):
     """``(result, best_seconds)`` for ``fn()`` over ``iters`` timed runs.
 
-    The result is pulled to the host each run (``jax.device_get``) so the
-    timing includes real completion — on remote-attached devices,
-    ``block_until_ready`` alone can return before execution finishes.
+    Works on ANY pytree result: each run blocks on the whole output via
+    ``jax.block_until_ready`` (tuples/dicts/dataclasses of arrays, and
+    non-array leaves, all handled — not just objects exposing a
+    ``.block_until_ready`` method), then pulls it to the host
+    (``jax.device_get``) so the timing includes real completion — on
+    remote-attached devices the fetch is the reliable barrier.
+
+    ``iters`` must be >= 1 (a "best of zero runs" has no answer);
+    negative ``warmup`` counts as zero.
     """
+    if iters < 1:
+        raise ValueError(
+            "timeit needs iters >= 1 (got %r): best-of is undefined over "
+            "zero timed runs" % (iters,))
     result = None
     for _ in range(max(warmup, 0)):
-        result = jax.device_get(fn())
+        result = jax.device_get(jax.block_until_ready(fn()))
     best = float("inf")
-    for _ in range(max(iters, 1)):
+    for _ in range(iters):
         t0 = time.perf_counter()
-        result = jax.device_get(fn())
+        result = jax.device_get(jax.block_until_ready(fn()))
         best = min(best, time.perf_counter() - t0)
     return result, best
 
@@ -170,7 +180,13 @@ def engine_counters():
     emitted by ``bolt_tpu.analysis.check``), ``strict_checks`` /
     ``strict_rejections`` (pre-dispatch checks run and dispatches
     refused inside an ``analysis.strict()`` scope).  The snapshot is
-    consistent — taken under the same lock every increment holds."""
+    consistent — taken under the same lock every increment holds.
+
+    Since PR 4 the backing store is the ``"engine"`` counter group in
+    the :mod:`bolt_tpu.obs.metrics` registry (this function is a thin
+    facade over ``engine.counters()``, itself a facade over the group):
+    identical keys, types and semantics, now enumerable alongside every
+    other metric via ``bolt_tpu.obs.registry().snapshot()``."""
     from bolt_tpu import engine
     return engine.counters()
 
@@ -187,34 +203,50 @@ def overlap_efficiency(counters=None):
     per run ``overlap = max(0, ingest + compute − wall)``.  ``0.0`` when
     nothing has streamed (or nothing overlapped); values toward ``1.0``
     mean transfer is fully hidden — the out-of-core pipeline runs at
-    compute speed, not ingest speed."""
+    compute speed, not ingest speed.
+
+    Well-defined on EVERY input: a fresh process, a CPU-only container
+    that never streamed, or a hand-built ``counters`` dict with keys
+    missing all return ``0.0`` instead of dividing by zero."""
     c = engine_counters() if counters is None else counters
-    ingest = c.get("stream_ingest_seconds", 0.0)
-    if not ingest:
+    ingest = c.get("stream_ingest_seconds", 0.0) or 0.0
+    if ingest <= 0.0:
         return 0.0
-    return c.get("stream_overlap_seconds", 0.0) / ingest
+    return (c.get("stream_overlap_seconds", 0.0) or 0.0) / ingest
 
 
 def engine_report(counters=None):
     """Human-readable table of the engine counters::
 
         print(bolt_tpu.profile.engine_report())
-    """
+
+    A fresh process (or an empty/all-zero ``counters`` dict) renders a
+    "(no engine activity)" note instead of raising or printing a wall
+    of zeros as if something ran."""
     c = engine_counters() if counters is None else counters
-    lines = ["%-20s %12s" % ("counter", "value")]
+    lines = ["%-24s %12s" % ("counter", "value")]
+    if not c or not any(v for v in c.values()):
+        lines.append("(no engine activity)")
+        return "\n".join(lines)
     for k in sorted(c):
         v = c[k]
-        lines.append("%-20s %12s"
+        lines.append("%-24s %12s"
                      % (k, ("%.4f" % v) if isinstance(v, float) else v))
     return "\n".join(lines)
 
 
 def memory_stats(device=None):
-    """Per-device memory counters (HBM on TPU) as a dict, or ``{}`` where
-    the backend doesn't expose them.  Keys follow the PJRT convention
-    (``bytes_in_use``, ``bytes_limit``, ``peak_bytes_in_use``, ...)."""
-    d = device if device is not None else jax.local_devices()[0]
+    """Per-device memory counters (HBM on TPU) as a dict.  Keys follow
+    the PJRT convention (``bytes_in_use``, ``bytes_limit``,
+    ``peak_bytes_in_use``, ...).
+
+    DOCUMENTED DEGRADED SHAPE: returns the empty dict ``{}`` — never
+    raises — when the backend lacks ``memory_stats()`` (CPU containers),
+    when the query returns nothing, or when no device is visible at
+    all; callers can always write ``memory_stats().get("bytes_in_use",
+    0)``."""
     try:
+        d = device if device is not None else jax.local_devices()[0]
         stats = d.memory_stats()
     except Exception:
         return {}

@@ -43,7 +43,6 @@ import contextlib
 import os
 import queue
 import threading
-import time
 import warnings
 
 import numpy as np
@@ -52,6 +51,8 @@ import jax
 import jax.numpy as jnp
 
 from bolt_tpu import engine as _engine
+from bolt_tpu.obs import trace as _obs
+from bolt_tpu.obs.trace import clock as _clock
 from bolt_tpu.utils import iter_record_blocks, prod
 
 # ---------------------------------------------------------------------
@@ -114,21 +115,29 @@ def transfer(x, sharding=None, wait=False):
     Host sources (anything that is not already a ``jax.Array``) tally
     their bytes into the engine's ``transfer_bytes``/``transfer_seconds``
     counters; device-resident inputs (resharding — an ICI exchange, not
-    host traffic) pass through uncounted.  ``wait=True`` blocks until the
-    transfer lands so the measured seconds cover the full upload (the
-    streaming prefetch thread uses this — blocking there is the point:
-    it is off the critical path)."""
+    host traffic) pass through uncounted.  EVERY counted upload blocks
+    until the buffer lands before its seconds are recorded — otherwise
+    ``transfer_seconds`` would tally async-dispatch time against the
+    full payload's bytes and report impossible GB/s (``wait`` is kept
+    for call-site documentation; the prefetch thread's blocking is the
+    point there — it is off the critical path, and host ``device_put``
+    is a synchronous copy in practice everywhere else)."""
     host = not isinstance(x, jax.Array)
-    t0 = time.perf_counter()
-    out = jax.device_put(x, sharding) if sharding is not None \
-        else jax.device_put(x)
-    if host:
-        if wait:
+    sp = _obs.begin("stream.transfer") if host else None
+    t0 = _clock()
+    try:
+        out = jax.device_put(x, sharding) if sharding is not None \
+            else jax.device_put(x)
+        if host:
             out.block_until_ready()
-        nbytes = getattr(x, "nbytes", None)
-        if nbytes is None:
-            nbytes = np.asarray(x).nbytes
-        _engine.record_transfer(int(nbytes), time.perf_counter() - t0)
+            nbytes = getattr(x, "nbytes", None)
+            if nbytes is None:
+                nbytes = np.asarray(x).nbytes
+            _engine.record_transfer(int(nbytes), _clock() - t0)
+            if sp is not None:
+                sp.set(bytes=int(nbytes), wait=wait)
+    finally:
+        _obs.end(sp)
     return out
 
 
@@ -152,7 +161,7 @@ class StreamSource:
     fold without ever materialising a compaction buffer."""
 
     __slots__ = ("kind", "produce", "blocks", "shape", "split", "dtype",
-                 "mesh", "slab", "stages", "_state")
+                 "mesh", "slab", "stages", "_state", "_consumed")
 
     def __init__(self, kind, produce, blocks, shape, split, dtype, mesh,
                  slab, stages=()):
@@ -166,6 +175,10 @@ class StreamSource:
         self.slab = int(slab)
         self.stages = tuple(stages)
         self._state = None
+        # iter sources stream ONCE per iter() of a one-shot iterable (a
+        # generator cannot rewind); the cell is SHARED across derived
+        # sources (with_stage) because they share the iterator itself
+        self._consumed = [False]
 
     # -- construction --------------------------------------------------
 
@@ -183,9 +196,11 @@ class StreamSource:
 
     def with_stage(self, stage):
         """A new source sharing the host side, one device stage longer."""
-        return StreamSource(self.kind, self.produce, self.blocks,
-                            self.shape, self.split, self.dtype, self.mesh,
-                            self.slab, self.stages + (stage,))
+        out = StreamSource(self.kind, self.produce, self.blocks,
+                           self.shape, self.split, self.dtype, self.mesh,
+                           self.slab, self.stages + (stage,))
+        out._consumed = self._consumed      # same iterator, same budget
+        return out
 
     # -- the host slab iterator ---------------------------------------
 
@@ -211,6 +226,19 @@ class StreamSource:
                 yield lo, hi, block
                 lo = hi
             return
+        # one-shot iterables (iter(x) is x: generators, file readers)
+        # cannot stream twice — raise a POINTED error instead of the
+        # misleading "blocks cover only 0 of N records" the exhausted
+        # iterator would otherwise produce downstream
+        if iter(self.blocks) is self.blocks:
+            if self._consumed[0]:
+                raise RuntimeError(
+                    "this fromiter source was already streamed and its "
+                    "iterator is exhausted (generators are one-shot); "
+                    "materialise once and reuse the result, pass a "
+                    "re-iterable (e.g. a list of blocks), or use "
+                    "fromcallback for random-access sources")
+            self._consumed[0] = True
         yield from iter_record_blocks(self.blocks, self.shape, self.dtype)
 
     def __repr__(self):
@@ -661,23 +689,40 @@ def execute(arr, terminal, ddof=None, rfunc=None):
 
     q = queue.Queue(maxsize=depth)
     stop = threading.Event()
+    # spans the prefetch thread begins parent under THIS run's span by
+    # explicit handoff (thread-local nesting does not cross threads):
+    # the exported timeline then shows ingest slabs under the run that
+    # caused them, overlapping the main thread's compute slabs
+    run_sp = _obs.begin("stream.run", terminal=terminal, depth=depth,
+                        kind=source.kind)
 
     def feeder():
+        slab_i = 0
         try:
             it = source.slabs()
             while True:
                 if stop.is_set():
                     return
-                t0 = time.perf_counter()
+                sp = _obs.begin("stream.ingest", parent=run_sp,
+                                slab=slab_i)
+                t0 = _clock()
                 try:
-                    lo, hi, block = next(it)
-                except StopIteration:
-                    break
-                buf = transfer(
-                    block,
-                    key_sharding(mesh, block.shape, split), wait=True)
-                tsec = time.perf_counter() - t0
+                    try:
+                        lo, hi, block = next(it)
+                    except StopIteration:
+                        _obs.cancel(sp)     # probe saw end-of-source
+                        sp = None
+                        break
+                    buf = transfer(
+                        block,
+                        key_sharding(mesh, block.shape, split), wait=True)
+                    tsec = _clock() - t0
+                    if sp is not None:
+                        sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
+                finally:
+                    _obs.end(sp)
                 del block
+                slab_i += 1
                 if not _put(q, (buf, tsec), stop):
                     return
             _put(q, _DONE, stop)
@@ -687,68 +732,94 @@ def execute(arr, terminal, ddof=None, rfunc=None):
     th = threading.Thread(target=feeder, name="bolt-stream-prefetch",
                           daemon=True)
     _LAST_THREAD = th
-    t_start = time.perf_counter()
+    t_start = _clock()
     ingest = 0.0
     compute = 0.0
     nslabs = 0
     fold = None
     th.start()
     try:
-        while True:
-            item = q.get()
-            if item is _DONE:
-                break
-            if isinstance(item, _StreamFault):
-                # clean abort: join the prefetch thread, release the
-                # ring, discard partials, re-raise the ORIGINAL error
-                raise item.exc
-            buf, tsec = item
-            ingest += tsec
-            t0 = time.perf_counter()
-            prog = _slab_program(source, terminal, buf.shape, ddof, rfunc)
-            with warnings.catch_warnings():
-                # backends without donation (the CPU dev mesh) warn that
-                # the donated slab buffer was unusable — expected there,
-                # and pure noise once per slab geometry
-                warnings.filterwarnings(
-                    "ignore", message="Some donated buffers were not usable")
-                part = prog(buf)
-            del buf, item                  # the donated ring slot is free
-            jax.block_until_ready(part)
-            compute += time.perf_counter() - t0
-            if fold is None:
-                # partials fold as a PAIRWISE tree for every terminal —
-                # the moments merge included, so power-of-two slab
-                # counts keep the Chan denominators exact
-                if terminal in ("sum", "reduce"):
-                    fold = _PairFold(_merge_program(
-                        terminal, part.shape, part.dtype, rfunc, mesh))
-                else:
-                    mp = _merge_program(terminal, part[1].shape,
-                                        part[1].dtype, None, mesh)
-                    fold = _PairFold(lambda a, b: tuple(mp(*a, *b)))
-            fold.push(part)
-            nslabs += 1
-    finally:
-        stop.set()
-        th.join()
-        while True:                       # release queued ring buffers
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                break
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, _StreamFault):
+                    # clean abort: join the prefetch thread, release the
+                    # ring, discard partials, re-raise the ORIGINAL error
+                    raise item.exc
+                buf, tsec = item
+                ingest += tsec
+                t0 = _clock()
+                csp = _obs.begin("stream.compute", slab=nslabs)
+                try:
+                    prog = _slab_program(source, terminal, buf.shape,
+                                         ddof, rfunc)
+                    with warnings.catch_warnings():
+                        # backends without donation (the CPU dev mesh)
+                        # warn that the donated slab buffer was unusable
+                        # — expected there, and pure noise once per slab
+                        # geometry
+                        warnings.filterwarnings(
+                            "ignore",
+                            message="Some donated buffers were not usable")
+                        part = prog(buf)
+                    del buf, item          # the donated ring slot is free
+                    jax.block_until_ready(part)
+                finally:
+                    _obs.end(csp)
+                compute += _clock() - t0
+                fsp = _obs.begin("stream.fold", slab=nslabs)
+                try:
+                    if fold is None:
+                        # partials fold as a PAIRWISE tree for every
+                        # terminal — the moments merge included, so
+                        # power-of-two slab counts keep the Chan
+                        # denominators exact
+                        if terminal in ("sum", "reduce"):
+                            fold = _PairFold(_merge_program(
+                                terminal, part.shape, part.dtype, rfunc,
+                                mesh))
+                        else:
+                            mp = _merge_program(terminal, part[1].shape,
+                                                part[1].dtype, None, mesh)
+                            fold = _PairFold(
+                                lambda a, b: tuple(mp(*a, *b)))
+                    fold.push(part)
+                finally:
+                    _obs.end(fsp)
+                nslabs += 1
+        finally:
+            stop.set()
+            th.join()
+            while True:                   # release queued ring buffers
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
 
-    if terminal in ("sum", "reduce"):
-        out = fold.result()
-    else:
-        n, mu, m2 = fold.result()
-        out = _finalise_program(terminal, mu.shape, mu.dtype, ddof,
-                                mesh)(n, mu, m2)
-    out.block_until_ready()
-    wall = time.perf_counter() - t_start
-    overlap = max(0.0, ingest + compute - wall)
-    _engine.record_stream(nslabs, ingest, compute, wall, overlap, depth)
-    return BoltArrayTPU(out, 0, mesh)
+        fsp = _obs.begin("stream.fold", final=True)
+        try:
+            if terminal in ("sum", "reduce"):
+                out = fold.result()
+            else:
+                n, mu, m2 = fold.result()
+                out = _finalise_program(terminal, mu.shape, mu.dtype,
+                                        ddof, mesh)(n, mu, m2)
+            out.block_until_ready()
+        finally:
+            _obs.end(fsp)
+        wall = _clock() - t_start
+        overlap = max(0.0, ingest + compute - wall)
+        _engine.record_stream(nslabs, ingest, compute, wall, overlap,
+                              depth)
+        if run_sp is not None:
+            run_sp.set(slabs=nslabs, ingest_s=round(ingest, 6),
+                       compute_s=round(compute, 6),
+                       overlap_s=round(overlap, 6))
+        return BoltArrayTPU(out, 0, mesh)
+    finally:
+        _obs.end(run_sp)
 
 
 # ---------------------------------------------------------------------
@@ -763,6 +834,12 @@ def materialize(source):
     paths — so a materialised stream is bit-identical to having never
     streamed at all.  Needs the full array to fit; streaming terminals
     exist so it usually never runs."""
+    with _obs.span("stream.materialize", kind=source.kind,
+                   stages=len(source.stages)):
+        return _materialize_spans(source)
+
+
+def _materialize_spans(source):
     b = _materialize_base(source)
     for stage in source.stages:
         kind = stage[0]
@@ -788,7 +865,7 @@ def _materialize_base(source):
     from bolt_tpu.tpu.array import BoltArrayTPU
     shape = source.shape
     sharding = key_sharding(source.mesh, shape, source.split)
-    t0 = time.perf_counter()
+    t0 = _clock()
     if source.kind == "callback":
         def produce(index):
             block = np.asarray(source.produce(index), dtype=source.dtype)
@@ -801,8 +878,7 @@ def _materialize_base(source):
             return block
         data = jax.make_array_from_callback(shape, sharding, produce)
         _engine.record_transfer(
-            prod(shape) * source.dtype.itemsize,
-            time.perf_counter() - t0)
+            prod(shape) * source.dtype.itemsize, _clock() - t0)
         return BoltArrayTPU(data, source.split, source.mesh)
     host = np.empty(shape, source.dtype)
     for lo, hi, block in source.slabs():

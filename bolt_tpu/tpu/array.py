@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from bolt_tpu import engine as _engine
 from bolt_tpu import stream as _streamlib
 from bolt_tpu.base import BoltArray, HostFallbackWarning
+from bolt_tpu.obs import trace as _obs
 from bolt_tpu.parallel.sharding import key_sharding
 from bolt_tpu.utils import (argpack, check_value_shape as _check_value_shape,
                             inshape, isreshapeable, istransposeable, prod,
@@ -619,6 +620,7 @@ class BoltArrayTPU(BoltArray):
         if self._donated:
             op = self._donated if isinstance(self._donated, str) \
                 else "a donating pipeline terminal"
+            _obs.event("array.donated_read", op=op)
             raise RuntimeError(
                 "this array's device buffer was donated to %s and can no "
                 "longer be read (donation-aware terminals consume a "
@@ -656,7 +658,8 @@ class BoltArrayTPU(BoltArray):
 
         fn = _cached_jit(("filter-fused", func, funcs, base.shape,
                           str(base.dtype), split, donate, mesh), build)
-        padded, cnt = fn(_check_live(base))
+        with _obs.span("array.filter", funcs=len(funcs), donate=donate):
+            padded, cnt = fn(_check_live(base))
         self._fpending = None
         self._pending = (padded, cnt)
         if donate:
@@ -700,9 +703,13 @@ class BoltArrayTPU(BoltArray):
             # recorded stage via the normal deferred/chunked/stacked
             # programs), then adopt the result
             source = self._stream
-            self._stream = None
             out = _streamlib.materialize(source)
             data = out._data            # resolves deferred/pending state
+            # adopt only AFTER materialisation succeeded: a transient
+            # source failure (an IOError mid-callback) must leave the
+            # array still streaming so a retry re-raises the REAL error
+            # instead of crashing on half-cleared state
+            self._stream = None
             self._concrete = data
             self._split = out._split
             self._aval = jax.ShapeDtypeStruct(data.shape, data.dtype)
@@ -727,7 +734,9 @@ class BoltArrayTPU(BoltArray):
 
             fn = _cached_jit(("chain", funcs, base.shape, str(base.dtype),
                               split, donate, mesh), build)
-            self._concrete = fn(_check_live(base))
+            with _obs.span("array.chain", funcs=len(funcs),
+                           donate=donate, bytes=int(base.nbytes)):
+                self._concrete = fn(_check_live(base))
             self._chain = None
             if donate:
                 _engine.donation_granted()
@@ -1062,7 +1071,8 @@ class BoltArrayTPU(BoltArray):
 
         fn = _cached_jit(("reduce", func, funcs, base.shape, str(base.dtype),
                           split, keepdims, donate, mesh), build)
-        out = self._wrap(fn(_check_live(base)), new_split)
+        with _obs.span("array.reduce", funcs=len(funcs), donate=donate):
+            out = self._wrap(fn(_check_live(base)), new_split)
         if donate:
             aligned._consume_donated("reduce()")
         return out
@@ -1120,7 +1130,9 @@ class BoltArrayTPU(BoltArray):
 
         fn = _cached_jit(("stat", name, funcs, base.shape, str(base.dtype),
                           split, axes, keepdims, ddof, donate, mesh), build)
-        out = self._wrap(fn(_check_live(base)), new_split)
+        with _obs.span("array.stat", op=name, funcs=len(funcs),
+                       donate=donate):
+            out = self._wrap(fn(_check_live(base)), new_split)
         if donate:
             self._consume_donated("%s()" % name)
         return out

@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding
 
 from bolt_tpu import engine as _engine
 from bolt_tpu import stream as _streamlib
+from bolt_tpu.obs import trace as _obs
 from bolt_tpu.parallel.sharding import combined_spec
 from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
                                 _canon, _chain_apply, _chain_donate_ok,
@@ -397,7 +398,8 @@ class ChunkedArray:
             fn = _cached_jit(("chunk-map-u", func, funcs, base.shape,
                               str(base.dtype), split, plan, vs_key, canon,
                               donate, mesh), build)
-            out = fn(_check_live(base))
+            with _obs.span("chunk.map", path="uniform", donate=donate):
+                out = fn(_check_live(base))
             if donate:
                 b._consume_donated("chunk().map()")
             new_plan = tuple(o // g for o, g in zip(out.shape[split:], grid))
@@ -423,7 +425,8 @@ class ChunkedArray:
         fn = _cached_jit(("chunk-map-g", func, funcs, base.shape,
                           str(base.dtype), split, plan, pad, vs_key, canon,
                           donate, mesh), build)
-        out = fn(_check_live(base))
+        with _obs.span("chunk.map", path="general", donate=donate):
+            out = fn(_check_live(base))
         if donate:
             b._consume_donated("chunk().map()")
         return ChunkedArray(BoltArrayTPU(out, split, mesh), plan, pad, vshard)

@@ -253,11 +253,11 @@ class ConstructTPU:
                     "(expected %s)" % (block.shape, index, want))
             return block
 
-        import time as _time
-        t0 = _time.perf_counter()
+        from bolt_tpu.obs.trace import clock as _clock
+        t0 = _clock()
         data = jax.make_array_from_callback(shape, sharding, produce)
         from bolt_tpu import engine as _engine
-        _engine.record_transfer(data.nbytes, _time.perf_counter() - t0)
+        _engine.record_transfer(data.nbytes, _clock() - t0)
         return BoltArrayTPU(data, split, mesh)
 
     @staticmethod

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from bolt_tpu import engine as _engine
 from bolt_tpu import stream as _streamlib
+from bolt_tpu.obs import trace as _obs
 from bolt_tpu.tpu.array import (BoltArrayTPU, _TRACE_ERRORS, _cached_jit,
                                 _canon, _chain_apply, _chain_donate_ok,
                                 _check_live, _check_value_shape, _constrain,
@@ -196,7 +197,8 @@ class StackedArray:
         fn = _cached_jit(("stack-map", func, funcs, base.shape,
                           str(base.dtype), split, size, canon, donate,
                           mesh), build)
-        out = fn(_check_live(base))
+        with _obs.span("stack.map", size=size, donate=donate):
+            out = fn(_check_live(base))
         if donate:
             b._consume_donated("stacked().map()")
         return StackedArray(BoltArrayTPU(out, split, mesh), size)

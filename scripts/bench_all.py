@@ -148,11 +148,15 @@ def pipelines(mesh=None, nkeys=16):
 
 def check_configs(mesh=None):
     """Run :func:`bolt_tpu.analysis.check` over every config pipeline;
-    verify zero compiles during checking and that the predicted
-    shape/dtype match the materialised result.  Returns a process exit
-    code (0 ok / 1 any mismatch or compile)."""
-    from bolt_tpu import analysis, engine
+    verify zero compiles during checking, that the predicted
+    shape/dtype match the materialised result, and — with the obs
+    tracer armed for the duration — that no config leaks an open span
+    (``obs.active_count()`` back to zero after each).  Returns a
+    process exit code (0 ok / 1 any mismatch, compile or leak)."""
+    from bolt_tpu import analysis, engine, obs
     failed = False
+    obs.clear()
+    obs.enable()
     for name, arr in pipelines(mesh=mesh):
         c0 = engine.counters()
         rep = analysis.check(arr)
@@ -170,13 +174,15 @@ def check_configs(mesh=None):
             shape_ok = (pred[0] is None and pred[1:] == got_shape[1:])
         else:
             shape_ok = pred == got_shape
+        leaked = obs.active_count()
         ok = (shape_ok and np.dtype(rep.dtype) == got_dtype
-              and compiled == 0)
+              and compiled == 0 and leaked == 0)
         print("   predicted %s %s | executed %s %s | compiles during "
-              "check: %d -> %s"
-              % (pred, rep.dtype, got_shape, got_dtype, compiled,
+              "check: %d | leaked spans: %d -> %s"
+              % (pred, rep.dtype, got_shape, got_dtype, compiled, leaked,
                  "OK" if ok else "MISMATCH"))
         failed = failed or not ok
+    obs.disable()
     return 1 if failed else 0
 
 
@@ -436,4 +442,18 @@ def main():
 if __name__ == "__main__":
     if "--check" in sys.argv:
         sys.exit(check_configs())
+    from bolt_tpu import obs
+    trace_path = obs.trace_arg(sys.argv)
+    if trace_path:
+        code = 0
+        try:
+            with obs.timeline(trace_path):
+                main()
+        except SystemExit as e:       # a parity MISMATCH exit: the trace
+            code = e.code or 0        # of the FAILED run is the point —
+        #                               report it before re-exiting
+        print(obs.report(), file=sys.stderr)
+        print("obs timeline written to %s (load in chrome://tracing or "
+              "Perfetto)" % trace_path, file=sys.stderr)
+        sys.exit(code)
     main()

@@ -22,6 +22,9 @@ Usage::
 
     python scripts/perf_regress.py              # measure + compare
     python scripts/perf_regress.py --rebaseline # overwrite the baseline
+    python scripts/perf_regress.py --trace out.json  # + obs timeline:
+        # Chrome trace-event export of the whole run, and each family's
+        # PERF.json entry gains a span-derived "phases" breakdown
 """
 
 import json
@@ -415,10 +418,30 @@ def print_table():
             r.get("tflops", ""), r.get("pct_mxu_peak", "")))
 
 
+def _phase_breakdown(spans):
+    """Span-derived phase totals for one family: per span name, summed
+    wall seconds over the family's spans.  Names nest (engine.dispatch
+    runs inside stream.compute), so entries overlap — this is a
+    breakdown by PHASE, not a partition of the family's wall clock."""
+    tot = {}
+    for s in spans:
+        d = s.duration
+        if d:
+            tot[s.name] = tot.get(s.name, 0.0) + d
+    return {k: round(v, 5) for k, v in sorted(tot.items())}
+
+
 def main():
     if "--table" in sys.argv:
         print_table()
         return 0
+    from bolt_tpu import obs as _obs
+    trace_path = _obs.trace_arg(sys.argv)
+    obs = None
+    if trace_path:
+        obs = _obs
+        obs.clear()
+        obs.enable(ring=65536)
     # BOLT_PERSISTENT_CACHE=<dir> wires the run to the on-disk XLA cache:
     # a warm perf run then skips every compile (persistent_hits in the
     # _engine entry confirms it), so short wall-clock budgets go to
@@ -446,6 +469,8 @@ def main():
     measured = set()   # families ACTUALLY run this invocation — the
                        # status report covers only these (seeded baseline
                        # entries would otherwise compare to themselves)
+    last_sid = 0       # obs-span watermark: spans above it belong to the
+                       # family currently measuring (--trace mode)
     for name, fam in FAMILIES:
         if only is not None and name not in only:
             continue
@@ -457,12 +482,32 @@ def main():
             # purge any stale number: a broken family must not regression-
             # gate on data from a previous run
             results.pop(name, None)
+            if obs is not None:
+                # consume the broken family's spans: its compiles and
+                # any leaked opens must not land in the NEXT family's
+                # "phases" attribution
+                last_sid = max((s.sid for s in obs.spans()),
+                               default=last_sid)
             continue
+        phases = None
+        if obs is not None:
+            fam_spans = [s for s in obs.spans() if s.sid > last_sid]
+            last_sid = max((s.sid for s in fam_spans), default=last_sid)
+            phases = _phase_breakdown(fam_spans)
+            leaked = obs.active_count()
+            if leaked:
+                print("family %s leaked %d active span(s)"
+                      % (name, leaked), file=sys.stderr)
         nbytes, sec = out[0], out[1]
         meta = out[2] if len(out) > 2 else {"bound": "hbm"}
         gbps = nbytes / sec / 1e9
         entry = {"s_per_iter": round(sec, 5), "bytes": nbytes,
                  "gbps": round(gbps, 1), "bound": meta["bound"]}
+        if phases:
+            # --trace mode: span-derived per-phase wall totals for the
+            # family (engine.lower/compile vs dispatch vs stream
+            # ingest/compute — where this family's time actually went)
+            entry["phases"] = phases
         # %-of-peak on the axis that bounds the family (VERDICT r3
         # next-1): HBM families get pct_hbm_peak, MXU families get
         # TFLOP/s against the per-precision MXU peak; latency-bound
@@ -529,6 +574,12 @@ def main():
           flush=True)
     with open(OUT, "w") as f:
         json.dump(results, f, indent=1, sort_keys=True)
+
+    if obs is not None:
+        obs.to_chrome(path=trace_path)
+        obs.disable()
+        print("obs timeline written to %s (load in chrome://tracing or "
+              "Perfetto)" % trace_path, file=sys.stderr)
 
     if rebase or not os.path.exists(BASE):
         with open(BASE, "w") as f:

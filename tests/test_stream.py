@@ -349,11 +349,19 @@ def test_stream_overlap_efficiency_positive(mesh):
 
     src = bolt.fromcallback(slow_loader, data.shape, mesh,
                             dtype=np.float64, chunks=2)
-    c0 = engine.counters()
-    src.map(heavy).sum()
-    c1 = engine.counters()
-    d = {k: c1[k] - c0[k] for k in c1}
-    assert d["stream_chunks"] == 6
+    # the wall-clock overlap is physical but probabilistic under heavy
+    # machine load (a saturated host can serialise the prefetch thread
+    # behind compute); a couple of retries keep the assertion about the
+    # PIPELINE, not the scheduler
+    d = None
+    for _ in range(3):
+        c0 = engine.counters()
+        src.map(heavy).sum()
+        c1 = engine.counters()
+        d = {k: c1[k] - c0[k] for k in c1}
+        assert d["stream_chunks"] == 6
+        if d["stream_overlap_seconds"] > 0.0:
+            break
     # the prefetch thread ingested slab i+1 while the executable ran on
     # slab i: ingest + compute strictly exceeds the wall clock
     assert d["stream_overlap_seconds"] > 0.0
@@ -399,6 +407,55 @@ def test_stream_fault_bad_block_shape(mesh):
     with pytest.raises(ValueError, match="returned shape"):
         bad.sum()
     assert not stream._LAST_THREAD.is_alive()
+
+
+def test_stream_materialise_failure_is_retryable(mesh):
+    # a TRANSIENT source failure during materialisation must leave the
+    # array streaming (not half-cleared): the retry re-raises nothing
+    # and succeeds, instead of crashing on None state
+    data = _intdata()
+    calls = []
+
+    def flaky(idx):
+        calls.append(idx)
+        if len(calls) == 1:
+            raise IOError("storage hiccup")
+        return data[idx]
+
+    src = bolt.fromcallback(flaky, SHAPE, mesh, dtype=np.float64,
+                            chunks=SHAPE[0])
+    with pytest.raises(IOError, match="storage hiccup"):
+        src.toarray()                           # materialising consumer
+    assert src.streaming                        # still a lazy source
+    assert np.array_equal(np.asarray(src.toarray()), data)
+
+
+def test_fromiter_exhausted_restream_raises_pointed_error(mesh):
+    # generators are one-shot: a second streamed terminal must say SO,
+    # not blame the block count ("cover only 0 of N records")
+    data = _intdata()
+
+    def gen():
+        yield data[:SHAPE[0] // 2]
+        yield data[SHAPE[0] // 2:]
+
+    src = bolt.fromiter(gen(), SHAPE, mesh, dtype=np.float64)
+    first = np.asarray(src.sum().toarray())
+    assert np.array_equal(first, data.sum(axis=0))
+    with pytest.raises(RuntimeError, match="already streamed"):
+        src.sum()
+    # derived sources share the iterator (with_stage), so the budget is
+    # shared too
+    src2 = bolt.fromiter(gen(), SHAPE, mesh, dtype=np.float64)
+    src2.map(lambda v: v * 2).sum()
+    with pytest.raises(RuntimeError, match="already streamed"):
+        src2.sum()
+    # RE-ITERABLE sources (a list of blocks) stream repeatedly — the
+    # guard is for one-shot iterators only
+    lst = bolt.fromiter([data], SHAPE, mesh, dtype=np.float64)
+    a = np.asarray(lst.sum().toarray())
+    b = np.asarray(lst.sum().toarray())
+    assert np.array_equal(a, b)
 
 
 # ---------------------------------------------------------------------
