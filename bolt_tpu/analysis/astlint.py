@@ -33,6 +33,12 @@ Rules (the ``BLT1xx`` range; the abstract pipeline checker owns
   timeline intervals) so every timing in the package shares one clock
   and lands on one exportable timeline instead of in scattered private
   stopwatches.
+* **BLT107** — no ``block_until_ready`` outside ``stream.py`` /
+  ``engine.py`` / ``profile.py``.  A stray sync point serialises the
+  dispatch pipeline — exactly the hazard the async streaming executor
+  removes; synchronisation belongs to the executor's bounded in-flight
+  window, the counted transfer layer, and the profiling barriers, not
+  to op code.
 
 A finding on line *N* is suppressed when that line carries a
 ``# lint: allow(BLT1xx <reason>)`` pragma — the escape hatch for the
@@ -53,6 +59,7 @@ RULES = {
     "BLT104": "._concrete access bypassing the _guard_donated gate",
     "BLT105": "raw jax.device_put outside the stream transfer layer",
     "BLT106": "raw time.perf_counter bookkeeping outside bolt_tpu.obs",
+    "BLT107": "stray block_until_ready sync point outside the executor",
 }
 
 # rule -> path suffixes (os-normalised) exempt from it; an entry ending
@@ -67,6 +74,9 @@ _EXEMPT = {
     "BLT105": ("stream.py",),
     # obs owns the clock; profile.py is the user-facing timing facade
     "BLT106": ("obs" + os.sep, "profile.py"),
+    # the executor's window/transfer syncs, the engine's AOT plumbing,
+    # and profile's timing barriers are the sanctioned sync points
+    "BLT107": ("stream.py", "engine.py", "profile.py"),
 }
 
 _VERSION_SENSITIVE = {
@@ -315,6 +325,27 @@ def lint_source(src, path="<string>"):
                  "raw jax.device_put bypasses the counted transfer layer "
                  "(transfer_bytes/transfer_seconds stay blind); route it "
                  "through bolt_tpu.stream.transfer")
+
+        # ---- BLT107: stray sync points outside the executor ------------
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "block_until_ready":
+            # covers jax.block_until_ready(x) AND x.block_until_ready()
+            emit("BLT107", node,
+                 "a block_until_ready here serialises the async dispatch "
+                 "pipeline (the perf hazard the streaming executor's "
+                 "bounded in-flight window exists to remove); let the "
+                 "executor/profiling layers own synchronisation")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and resolved(node.func) == "jax.block_until_ready":
+            # from-import form: `from jax import block_until_ready`
+            # (the dotted form is an Attribute — the branch above; this
+            # one must not double-report it off the enclosing Call)
+            emit("BLT107", node,
+                 "a block_until_ready here serialises the async dispatch "
+                 "pipeline (the perf hazard the streaming executor's "
+                 "bounded in-flight window exists to remove); let the "
+                 "executor/profiling layers own synchronisation")
 
         # ---- BLT106: raw perf_counter bookkeeping outside obs ----------
         if isinstance(node, ast.Call) \

@@ -382,8 +382,10 @@ def _check_stream(arr, target, stages, diags):
         0, "stream source (%s)" % src.kind, aval.shape,
         np.dtype(aval.dtype), walk_split,
         _spec(mesh, aval.shape, walk_split),
-        note="out-of-core: ~%d slabs of %d records, prefetch depth %d"
-             % (nslabs, src.slab, _stream.prefetch_depth())))
+        note="out-of-core: ~%d slabs of %d records, prefetch depth %d, "
+             "uploader pool %d"
+             % (nslabs, src.slab, _stream.prefetch_depth(),
+                _stream.pool_size(src))))
     idle_seen = _idle_device_check(mesh, aval.shape, walk_split, 0, diags,
                                    False)
     dynamic = False

@@ -102,17 +102,28 @@ _SCHEMA = {
     # host<->device traffic accounting (fed by bolt_tpu.stream.transfer —
     # the ONE device_put wrapper, enforced by lint rule BLT105)
     "transfer_bytes": 0,      # host bytes shipped to device
-    "transfer_seconds": 0.0,  # wall time inside counted transfers
+    "transfer_seconds": 0.0,  # seconds inside counted transfers, summed
+                              # across uploader-pool workers (concurrent
+                              # uploads can exceed wall time, so derive
+                              # per-worker link rate, not absolute GB/s)
     # streaming-executor accounting (bolt_tpu.stream: the out-of-core
     # double-buffered pipeline).  overlap_seconds is ingest time hidden
     # behind device compute: max(0, ingest + compute - wall) per run;
     # profile.overlap_efficiency() reports it as a fraction of ingest.
     "stream_chunks": 0,           # slabs streamed through the executor
-    "stream_ingest_seconds": 0.0,  # prefetch-thread produce+upload time
-    "stream_compute_seconds": 0.0,  # main-thread per-slab compute time
+    "stream_ingest_seconds": 0.0,  # uploader-pool produce+upload time
+                                   # (summed across workers: parallel
+                                   # ingest can exceed wall time)
+    "stream_compute_seconds": 0.0,  # main-thread dispatch + sync time
     "stream_wall_seconds": 0.0,    # end-to-end streamed-run wall time
     "stream_overlap_seconds": 0.0,  # ingest hidden behind compute
     "stream_prefetch_depth": 0,    # high-water configured prefetch depth
+    "stream_upload_threads": 0,    # high-water CONCURRENT uploader
+                                   # workers observed mid-upload (>1 is
+                                   # the parallel-ingest proof)
+    "stream_inflight_high_water": 0,  # high-water slab programs
+                                      # dispatched but not yet confirmed
+                                      # complete (the async window)
 }
 
 _COUNTERS = _metrics.registry().group("engine", _SCHEMA)
@@ -354,11 +365,16 @@ def record_transfer(nbytes, seconds):
     _TRANSFER_HIST.observe(int(nbytes))
 
 
-def record_stream(chunks, ingest_s, compute_s, wall_s, overlap_s, depth):
+def record_stream(chunks, ingest_s, compute_s, wall_s, overlap_s, depth,
+                  uploaders=1, inflight=1):
     """Tally one completed streamed run (bolt_tpu.stream executor); the
-    six keys apply atomically — a snapshot can never see a run's wall
-    time without its overlap."""
-    _COUNTERS.update(_maxima={"stream_prefetch_depth": int(depth)},
+    keys apply atomically — a snapshot can never see a run's wall time
+    without its overlap.  ``uploaders`` is the run's observed concurrent
+    uploader high-water, ``inflight`` its dispatched-but-unconfirmed
+    slab-program high-water; both (and the depth) keep process maxima."""
+    _COUNTERS.update(_maxima={"stream_prefetch_depth": int(depth),
+                              "stream_upload_threads": int(uploaders),
+                              "stream_inflight_high_water": int(inflight)},
                      stream_chunks=int(chunks),
                      stream_ingest_seconds=ingest_s,
                      stream_compute_seconds=compute_s,

@@ -158,6 +158,32 @@ def key_sharding(mesh, shape, split):
     return NamedSharding(mesh, key_spec(mesh, shape, split))
 
 
+def device_placements(mesh, shape, split):
+    """``(sharding, [(device, index)])``: the per-device sub-block layout
+    of one host array of ``shape`` under the key sharding.
+
+    ``index`` is the tuple of slices device ``d`` holds — ``block[index]``
+    is exactly the sub-block to place on ``d``.  Replicated axes (key
+    extents the mesh does not divide, and all value axes) repeat the full
+    slice on every device.  The streaming executor's uploader pool uses
+    this to ship one slab as independent per-device ``device_put`` calls
+    (each worker uploads its slab's sub-blocks while other workers upload
+    theirs) and then assembles the global array with
+    :func:`assemble_from_parts` — no single-threaded whole-slab
+    placement on the hot path."""
+    sharding = key_sharding(mesh, shape, split)
+    items = sharding.addressable_devices_indices_map(tuple(shape))
+    return sharding, list(items.items())
+
+
+def assemble_from_parts(shape, sharding, parts):
+    """Glue per-device buffers (one per :func:`device_placements` entry,
+    same order) into one global ``jax.Array`` — the zero-copy inverse of
+    the placement map."""
+    return jax.make_array_from_single_device_arrays(
+        tuple(shape), sharding, parts)
+
+
 def reshard(data, mesh, split):
     """Place ``data`` according to the key sharding for ``split``.
 

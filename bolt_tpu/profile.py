@@ -200,10 +200,14 @@ def overlap_efficiency(counters=None):
     """Fraction of streaming ingest time (host production + upload)
     hidden behind device compute, from the engine's ``stream_*``
     counters: ``stream_overlap_seconds / stream_ingest_seconds`` where
-    per run ``overlap = max(0, ingest + compute − wall)``.  ``0.0`` when
-    nothing has streamed (or nothing overlapped); values toward ``1.0``
-    mean transfer is fully hidden — the out-of-core pipeline runs at
-    compute speed, not ingest speed.
+    per run ``overlap = max(0, ingest + compute − wall)``.  Ingest is
+    summed across the uploader pool's workers (parallel ingest can
+    exceed wall time — that surplus IS hidden work), and compute is the
+    consumer's dispatch + window/final sync time, so the ratio stays
+    meaningful under async dispatch.  ``0.0`` when nothing has streamed
+    (or nothing overlapped); values toward ``1.0`` mean transfer is
+    fully hidden — the out-of-core pipeline runs at compute speed, not
+    ingest speed.
 
     Well-defined on EVERY input: a fresh process, a CPU-only container
     that never streamed, or a hand-built ``counters`` dict with keys

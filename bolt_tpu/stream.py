@@ -1,4 +1,5 @@
-"""Streaming out-of-core executor: a double-buffered host↔device pipeline.
+"""Streaming out-of-core executor: a parallel-ingest, async-dispatch
+host↔device pipeline.
 
 Every other execution path in this backend materialises its operand fully
 in device memory before a terminal runs, which caps the workload class at
@@ -7,18 +8,34 @@ HBM.  This module opens datasets LARGER than device memory: a lazy
 record *slabs* (consecutive blocks along the first key axis) plus a chain
 of device-side stages (per-record maps, chunked maps, stacked maps, a
 trailing filter predicate), and :func:`execute` runs a reduction terminal
-over it as a depth-``k`` pipeline:
+over it as a pipelined fan-in:
 
-* a **prefetch thread** produces slab *i+1* on host and uploads it
-  (:func:`transfer` — the ONE counted ``jax.device_put`` wrapper, see
-  lint rule BLT105) while the engine's AOT executable processes slab *i*;
-* slab buffers form a **ring** bounded by the prefetch depth, and each is
-  **donated** into its per-slab program (``donate_argnums``), so XLA
-  recycles the ring's device memory instead of allocating per slab;
-* reduction terminals fold per-slab partials ON DEVICE — a pairwise tree
-  of ``add``/``func`` merges for ``sum``/``reduce``, a Welford/Chan
-  statcounter-moment merge (``n, μ, M2``) for ``mean``/``var``/``std`` —
-  so host traffic is one slab in, one value-block out.
+* an **N-way uploader pool** (default ``min(mesh devices, 4)``;
+  ``BOLT_STREAM_UPLOAD_THREADS`` / the :func:`uploaders` scope) ingests
+  slabs concurrently — for random-access ``fromcallback`` sources each
+  worker produces AND uploads its own slab (per-device sub-blocks via
+  ``parallel.sharding.device_placements``), so one CPU thread is never
+  the bottleneck feeding many chips; sequential ``fromiter`` sources
+  keep one produce+upload prefetch thread.  A **re-sequencer** hands
+  completed slabs to the consumer strictly in slab order, so the fold
+  is deterministic and bit-exact regardless of upload completion order;
+* slab buffers form a **ring** bounded by ``prefetch depth + pool
+  size``, and each is **donated** into its per-slab program
+  (``donate_argnums``), so XLA recycles the ring's device memory
+  instead of allocating per slab;
+* slab programs **dispatch asynchronously** into a bounded in-flight
+  window — no per-slab ``block_until_ready``; the consumer syncs only
+  on window overflow (an already-retired old partial, ~free) and on the
+  final result, so device compute and host ingest overlap fully;
+* reduction terminals fold per-slab partials ON DEVICE — the **level-0
+  fold is fused into the slab program** (odd slabs run ``prog(buf,
+  acc)``, merging with the preceding slab's partial in the same
+  dispatch — half the fold dispatches), and a pairwise tree of
+  ``add``/``func`` merges for ``sum``/``reduce``, a Welford/Chan
+  statcounter-moment merge (``n, μ, M2``) for ``mean``/``var``/``std``,
+  combines pair-partials above level 0 — so host traffic is one slab
+  in, one value-block out, and power-of-two slab counts keep the Chan
+  denominators exact.
 
 The per-slab program applies the SAME traced bodies the materialised
 paths compile (``tpu/chunk.py :: _uniform_map_body`` /
@@ -29,14 +46,23 @@ suite (``tests/test_stream.py``) bit-compares them.
 
 Accounting lands in the engine counters (``transfer_bytes`` /
 ``transfer_seconds`` for every counted upload, the ``stream_*`` family
-for the executor); :func:`bolt_tpu.profile.overlap_efficiency` reports
-the fraction of ingest time hidden behind device compute —
-``max(0, ingest + compute - wall) / ingest`` per run.
+for the executor — including ``stream_upload_threads``, the observed
+concurrent-uploader high-water, and ``stream_inflight_high_water``, the
+async dispatch window's peak).  Ingest/compute seconds are attributed
+from the same instrumented regions the obs spans cover (worker
+``stream.ingest`` spans, consumer ``stream.compute`` dispatch +
+``stream.sync`` windows), NOT from wall-clock around a per-slab sync;
+:func:`bolt_tpu.profile.overlap_efficiency` reports the fraction of
+ingest time hidden behind device compute — ``max(0, ingest + compute -
+wall) / ingest`` per run.
 
-Fault model: a source callback that raises mid-stream aborts cleanly —
-the prefetch thread is joined, queued ring buffers are released, the
-partial reduction state is discarded, and the ORIGINAL exception is
-re-raised to the caller.
+Fault model: a source callback or uploader worker that raises mid-stream
+aborts cleanly — the whole pool is joined, queued ring buffers are
+released, the partial reduction state is discarded, and the ORIGINAL
+exception is re-raised to the caller.  A pool thread that dies WITHOUT
+delivering (interpreter teardown, a killed thread) is detected by the
+consumer's liveness poll, which raises a pointed ``RuntimeError`` naming
+the dead thread instead of blocking forever.
 """
 
 import contextlib
@@ -44,6 +70,7 @@ import os
 import queue
 import threading
 import warnings
+from collections import deque
 
 import numpy as np
 
@@ -60,10 +87,19 @@ from bolt_tpu.utils import iter_record_blocks, prod
 # ---------------------------------------------------------------------
 
 # prefetch depth k: how many uploaded slabs may wait ahead of the
-# consumer (the ring size).  2 = classic double buffering: one slab in
-# compute, one in flight.  Deeper rings only help when per-slab ingest
-# time is noisy; they cost one slab of HBM each.
+# consumer beyond the uploader pool's own hands-on slabs (the ring is
+# bounded at depth + pool size).  2 = classic double buffering: one slab
+# in compute, one in flight.  Deeper rings only help when per-slab
+# ingest time is noisy; they cost one slab of HBM each.
 _DEPTH = max(1, int(os.environ.get("BOLT_STREAM_DEPTH", "2")))
+
+# uploader pool size: concurrent ingest workers.  0 = auto, resolved per
+# run as min(mesh device count, 4) — one host thread cannot saturate the
+# link feeding many chips, but past ~4 workers the host memory bus is
+# the limit, not thread count.  Sequential (fromiter) sources always
+# stream through ONE produce+upload prefetch thread regardless.
+_UPLOADERS = max(0, int(os.environ.get("BOLT_STREAM_UPLOAD_THREADS",
+                                       "0")))
 
 # default slab budget when the caller gives no explicit record count:
 # big enough to amortise per-dispatch overhead, small enough that
@@ -96,6 +132,48 @@ def prefetch(depth):
         yield
     finally:
         _DEPTH = old
+
+
+def upload_threads():
+    """The configured uploader-pool size (0 = auto: resolved per run as
+    ``min(mesh devices, 4)``)."""
+    return _UPLOADERS
+
+
+def set_upload_threads(n):
+    """Set the process-wide uploader-pool size (0 restores auto)."""
+    global _UPLOADERS
+    _UPLOADERS = max(0, int(n))
+
+
+@contextlib.contextmanager
+def uploaders(n):
+    """Scope the uploader-pool size (``0`` = auto, like
+    :func:`set_upload_threads`)::
+
+        with bolt_tpu.stream.uploaders(8):
+            src.map(f).sum()
+    """
+    global _UPLOADERS
+    old = _UPLOADERS
+    _UPLOADERS = max(0, int(n))
+    try:
+        yield
+    finally:
+        _UPLOADERS = old
+
+
+def pool_size(source):
+    """The uploader-pool size a run over ``source`` will use: the
+    configured count (scope/env), else ``min(mesh devices, 4)``;
+    sequential ``fromiter`` sources always use ONE prefetch thread
+    (their iterator cannot be consumed concurrently)."""
+    if source.kind != "callback":
+        return 1
+    if _UPLOADERS >= 1:
+        return _UPLOADERS
+    ndev = int(source.mesh.devices.size) if source.mesh is not None else 1
+    return min(max(ndev, 1), 4)
 
 
 def _cached_jit(key, builder):
@@ -136,6 +214,37 @@ def transfer(x, sharding=None, wait=False):
             _engine.record_transfer(int(nbytes), _clock() - t0)
             if sp is not None:
                 sp.set(bytes=int(nbytes), wait=wait)
+    finally:
+        _obs.end(sp)
+    return out
+
+
+def _upload_slab(block, mesh, split):
+    """Upload ONE host slab as its per-device sub-blocks and assemble
+    the global sharded array — the uploader-pool hot path.
+
+    Per-device placement (``parallel.sharding.device_placements``) keeps
+    each worker's uploads independent: N workers each ``device_put``
+    their own slab's sub-blocks concurrently, with no shared whole-slab
+    placement call serialising them.  Counted ONCE per slab (logical
+    host bytes, like :func:`transfer` — replication is a placement
+    detail, not payload), and every sub-block is blocked on before the
+    seconds are recorded, so ``transfer_seconds`` stays honest."""
+    from bolt_tpu.parallel import sharding as _sh
+    sp = _obs.begin("stream.transfer")
+    t0 = _clock()
+    try:
+        sharding, placements = _sh.device_placements(mesh, block.shape,
+                                                     split)
+        parts = [jax.device_put(block[index], dev)
+                 for dev, index in placements]
+        for p in parts:
+            p.block_until_ready()
+        out = _sh.assemble_from_parts(block.shape, sharding, parts)
+        nbytes = int(block.nbytes)
+        _engine.record_transfer(nbytes, _clock() - t0)
+        if sp is not None:
+            sp.set(bytes=nbytes, parts=len(parts))
     finally:
         _obs.end(sp)
     return out
@@ -204,27 +313,34 @@ class StreamSource:
 
     # -- the host slab iterator ---------------------------------------
 
+    def produce_slab(self, lo, hi):
+        """Produce ONE validated host block for records ``[lo, hi)`` —
+        the random-access path the uploader-pool workers call
+        CONCURRENTLY (callback sources only; the callback must therefore
+        be thread-safe, which slicing a memmap/HDF5-style store is)."""
+        rest = self.shape[1:]
+        index = (slice(lo, hi),) + tuple(slice(0, s) for s in rest)
+        block = np.asarray(self.produce(index), dtype=self.dtype)
+        if block.shape != (hi - lo,) + rest:
+            raise ValueError(
+                "fromcallback callback returned shape %s for index "
+                "%s (expected %s)"
+                % (block.shape, index, (hi - lo,) + rest))
+        return block
+
+    def slab_ranges(self):
+        """``(lo, hi)`` record ranges of every slab, in key order."""
+        n, slab = self.shape[0], self.slab
+        return [(lo, min(lo + slab, n)) for lo in range(0, n, slab)]
+
     def slabs(self):
         """Yield ``(lo, hi, block)`` record slabs in key order; blocks
         are validated and cast to the source dtype.  Callback sources
         slice on demand; iterator sources stream whatever block sizes
         the iterable yields and must cover the shape exactly."""
-        n = self.shape[0]
-        rest = self.shape[1:]
         if self.kind == "callback":
-            tail = tuple(slice(0, s) for s in rest)
-            lo = 0
-            while lo < n:
-                hi = min(lo + self.slab, n)
-                index = (slice(lo, hi),) + tail
-                block = np.asarray(self.produce(index), dtype=self.dtype)
-                if block.shape != (hi - lo,) + rest:
-                    raise ValueError(
-                        "fromcallback callback returned shape %s for index "
-                        "%s (expected %s)"
-                        % (block.shape, index, (hi - lo,) + rest))
-                yield lo, hi, block
-                lo = hi
+            for lo, hi in self.slab_ranges():
+                yield lo, hi, self.produce_slab(lo, hi)
             return
         # one-shot iterables (iter(x) is x: generators, file readers)
         # cannot stream twice — raise a POINTED error instead of the
@@ -492,11 +608,37 @@ def maybe_reduce(arr, func, axes, keepdims):
 # per-slab programs and on-device partial merges
 # ---------------------------------------------------------------------
 
-def _slab_program(source, terminal, slab_shape, ddof, rfunc):
+def _combine(terminal, rfunc, a, b):
+    """The ONE partial-merge arithmetic — traced by BOTH the standalone
+    merge program (the pairwise tree above level 0) and the acc-fused
+    slab program (level 0), so in-program and between-program merges
+    cannot drift.  ``a`` is the EARLIER partial (fold order matters for
+    ``reduce``); moments partials are ``(n, mu, M2)`` triples merged by
+    the Chan et al. parallel recurrence (the statcounter ``mergeStats``
+    formula, vectorised over the value block)."""
+    if terminal == "sum":
+        return jnp.add(a, b)
+    if terminal == "reduce":
+        return rfunc(a, b)
+    n1, mu1, m21 = a
+    n2, mu2, m22 = b
+    n = n1 + n2
+    safe = jnp.where(n > 0, n, jnp.asarray(1, n.dtype))
+    delta = mu2 - mu1
+    mu = mu1 + delta * (n2 / safe)
+    m2 = m21 + m22 + delta * delta * (n1 * n2 / safe)
+    return n, mu, m2
+
+
+def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False):
     """The ONE compiled program each slab runs: device-side stages +
     (masked) terminal partial, with the slab buffer DONATED so the ring
-    recycles its memory.  Engine-cached per (stages, terminal, slab
-    geometry): uniform slabs compile exactly once."""
+    recycles its memory.  ``fused=True`` is the level-0 fold fusion: the
+    program additionally takes the PREVIOUS slab's partial and merges it
+    in the same dispatch (``prog(buf, acc)``), halving fold dispatches —
+    the acc is donated too, it is consumed.  Engine-cached per (stages,
+    terminal, slab geometry, fused): uniform slabs compile exactly once
+    per variant."""
     stages = source.stages
     pred = None
     if stages and stages[-1][0] == "filter":
@@ -504,11 +646,12 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc):
         stages = stages[:-1]
     split = source.split
     mesh = source.mesh
-    key = ("stream-slab", terminal, stages, pred, slab_shape,
-           str(source.dtype), split, ddof, rfunc, mesh)
+    key = ("stream-slab-acc" if fused else "stream-slab", terminal,
+           stages, pred, slab_shape, str(source.dtype), split, ddof,
+           rfunc, mesh)
 
     def build():
-        def run(data):
+        def partial(data):
             from bolt_tpu.tpu.array import _pred_mask
             x = data
             for stg in stages:
@@ -560,34 +703,37 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc):
                 dev = jnp.where(mfull, dev, jnp.asarray(0, out_dt))
             m2 = jnp.sum(dev * dev, axis=0)
             return cnt, mu, m2
-        return jax.jit(run, donate_argnums=(0,))
+
+        if not fused:
+            return jax.jit(partial, donate_argnums=(0,))
+
+        def run(data, acc):
+            # level-0 fold fused in: acc (the EVEN slab's partial) merges
+            # with this (ODD) slab's partial inside one dispatch
+            return _combine(terminal, rfunc, acc, partial(data))
+        return jax.jit(run, donate_argnums=(0, 1))
 
     return _cached_jit(key, build)
 
 
 def _merge_program(terminal, shape, dtype, rfunc, mesh):
-    """On-device merge of two per-slab partials (tiny, engine-cached)."""
+    """On-device merge of two pair-partials — the tree above level 0
+    (tiny, engine-cached, same :func:`_combine` arithmetic the fused
+    slab program traces)."""
     if terminal in ("sum", "reduce"):
         key = ("stream-merge", terminal, rfunc, tuple(shape), str(dtype),
                mesh)
 
         def build():
-            op = jnp.add if terminal == "sum" else rfunc
-            return jax.jit(lambda a, b: op(a, b))
+            return jax.jit(lambda a, b: _combine(terminal, rfunc, a, b))
         return _cached_jit(key, build)
 
     key = ("stream-merge-moments", tuple(shape), str(dtype), mesh)
 
     def build():
         def merge(n1, mu1, m21, n2, mu2, m22):
-            # Chan et al. parallel-moments combine — the statcounter
-            # ``mergeStats`` recurrence, vectorised over the value block
-            n = n1 + n2
-            safe = jnp.where(n > 0, n, jnp.asarray(1, n.dtype))
-            delta = mu2 - mu1
-            mu = mu1 + delta * (n2 / safe)
-            m2 = m21 + m22 + delta * delta * (n1 * n2 / safe)
-            return n, mu, m2
+            return _combine("moments", None, (n1, mu1, m21),
+                            (n2, mu2, m22))
         return jax.jit(merge)
     return _cached_jit(key, build)
 
@@ -613,15 +759,25 @@ def _finalise_program(terminal, shape, dtype, ddof, mesh):
 
 
 class _PairFold:
-    """Binary-counter pairwise tree over streamed partials: partial *i*
-    merges at tree level ``trailing_zeros(i)``, so the fold depth is
-    log2(nslabs) and no more than log2(n) partials are ever alive."""
+    """Binary-counter pairwise tree over streamed PAIR partials (level-0
+    merges are fused into the odd slab programs): leaf *i* merges at
+    tree level ``trailing_zeros(i)``, so the fold depth is log2(nleaves)
+    and no more than log2(n) partials are ever alive.  The merge program
+    resolves LAZILY on the first actual merge — a 1- or 2-slab stream
+    never builds (or counts) it."""
 
-    __slots__ = ("merge", "levels")
+    __slots__ = ("_factory", "_merge", "levels")
 
-    def __init__(self, merge):
-        self.merge = merge
+    def __init__(self, merge_factory):
+        self._factory = merge_factory
+        self._merge = None
         self.levels = []
+
+    def merge(self, a, b):
+        if self._merge is None:
+            self._merge = self._factory()
+            self._factory = None        # hold nothing beyond the program
+        return self._merge(a, b)
 
     def push(self, x):
         lvl = 0
@@ -647,64 +803,220 @@ class _PairFold:
 # the executor
 # ---------------------------------------------------------------------
 
-class _StreamFault:
-    """Queue sentinel carrying a prefetch-thread exception."""
-
-    __slots__ = ("exc",)
-
-    def __init__(self, exc):
-        self.exc = exc
-
-
-_DONE = object()
-
-# the most recent prefetch thread (introspection for the fault tests)
+# the most recent prefetch/dispenser thread and full pool
+# (introspection for the fault tests)
 _LAST_THREAD = None
+_LAST_POOL = ()
 
 
-def _put(q, item, stop):
-    """Bounded put that gives up when the consumer has aborted (the
-    prefetch thread must never deadlock on a dead main loop)."""
-    while True:
-        try:
-            q.put(item, timeout=0.05)
+class _Reseq:
+    """Slab-order re-sequencing buffer between the uploader pool and the
+    consumer: workers insert completed slabs by index, the consumer pops
+    them STRICTLY in slab order — the fold stays deterministic and
+    bit-exact no matter which upload finishes first.  Also the fault
+    funnel: the first worker exception is recorded and re-raised in the
+    consumer, and a liveness poll catches pool threads that died without
+    delivering (the ``q.get()``-blocks-forever bug)."""
+
+    __slots__ = ("_cond", "_slots", "_next", "_exc", "_total")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._slots = {}
+        self._next = 0
+        self._exc = None
+        self._total = None
+
+    def put(self, i, item):
+        with self._cond:
+            self._slots[i] = item
+            self._cond.notify_all()
+
+    def fault(self, exc):
+        """Record the FIRST failure (later ones are consequences)."""
+        with self._cond:
+            if self._exc is None:
+                self._exc = exc
+            self._cond.notify_all()
+
+    def finish(self, total):
+        """All slabs dispensed: ``total`` is the slab count."""
+        with self._cond:
+            self._total = total
+            self._cond.notify_all()
+
+    def drain(self):
+        """Release every queued ring buffer (abort path)."""
+        with self._cond:
+            self._slots.clear()
+
+    def _dead(self, threads):
+        """Pointed error naming the dead pool threads — the fix for the
+        q.get()-blocks-forever bug."""
+        dead = [t for t in threads if not t.is_alive()] or threads
+        return RuntimeError(
+            "streaming prefetch thread(s) %s died without delivering "
+            "slab %d or an error (thread killed before it could enqueue "
+            "— e.g. interpreter teardown); the stream cannot complete"
+            % (", ".join(repr(t.name) for t in dead), self._next))
+
+    def next(self, threads, workers=None, timeout=0.1, stall_limit=300):
+        """The next ``(slab_i, item)`` in slab order, or ``None`` at
+        end-of-stream.  Re-raises a recorded pool fault; polls with a
+        timeout and liveness checks so pool threads that died WITHOUT
+        enqueueing (interpreter teardown, a killed thread) surface as a
+        pointed error instead of blocking the consumer forever:
+
+        * every INGESTING thread (``workers``, else all of ``threads``)
+          dead with the needed slab undelivered → nothing can ever
+          arrive, raise immediately (the dispenser alone cannot upload,
+          so it blocking on ring permits must not mask dead workers);
+        * the lead dispenser dead before announcing the slab count,
+          workers alive but starved of jobs → raise after
+          ``stall_limit`` polls with no new delivery (~30 s grace so a
+          genuinely slow in-hand upload is not mistaken for the hang).
+        """
+        ingesters = threads if workers is None else workers
+        lead = threads[0]
+        stalls = 0
+        seen = -1
+        with self._cond:
+            while True:
+                if self._exc is not None:
+                    raise self._exc
+                if self._next in self._slots:
+                    i = self._next
+                    self._next += 1
+                    return i, self._slots.pop(i)
+                if self._total is not None and self._next >= self._total:
+                    return None
+                if not any(t.is_alive() for t in ingesters):
+                    raise self._dead(threads)
+                if not lead.is_alive() and self._total is None:
+                    # a delivery (even out-of-order) is progress: a
+                    # worker finished an in-hand slab — reset the clock
+                    if len(self._slots) != seen:
+                        seen = len(self._slots)
+                        stalls = 0
+                    stalls += 1
+                    if stalls > stall_limit:
+                        raise self._dead(threads)
+                self._cond.wait(timeout)
+
+
+def _acquire(sem, stop):
+    """Ring-permit acquire that gives up when the run is aborting (a
+    pool thread must never deadlock on a dead main loop)."""
+    while not stop.is_set():
+        if sem.acquire(timeout=0.05):
             return True
-        except queue.Full:
-            if stop.is_set():
-                return False
+    return False
 
 
 def execute(arr, terminal, ddof=None, rfunc=None):
     """Run a streamed reduction terminal over ``arr``'s source: the
-    double-buffered prefetch pipeline described in the module docstring.
-    Returns a value-shaped ``BoltArrayTPU`` (``split=0``)."""
-    global _LAST_THREAD
-    from bolt_tpu.parallel.sharding import key_sharding
+    parallel-ingest, async-dispatch pipeline described in the module
+    docstring.  Returns a value-shaped ``BoltArrayTPU`` (``split=0``)."""
+    global _LAST_THREAD, _LAST_POOL
     from bolt_tpu.tpu.array import BoltArrayTPU
     source = arr._stream
     _engine.strict_guard(arr, "stream.%s()" % terminal)
     mesh = source.mesh
     split = source.split
     depth = prefetch_depth()
-
-    q = queue.Queue(maxsize=depth)
+    nwork = pool_size(source)
+    # the donated ring: at most depth + pool-size slab buffers exist at
+    # once (each worker holds one in hand, depth more may wait uploaded
+    # or dispatched-unconfirmed).  A permit is acquired per dispensed
+    # slab and released when the consumer CONFIRMS its program retired
+    # (the in-flight window sync) — so ring memory stays capped even
+    # though dispatch is async.
+    ring = depth + nwork
+    window = ring - 1          # one slot always free for the dispenser
+    permits = threading.Semaphore(ring)
     stop = threading.Event()
-    # spans the prefetch thread begins parent under THIS run's span by
+    rsq = _Reseq()
+    # concurrent-uploader accounting (the parallel-ingest proof in the
+    # engine counters: stream_upload_threads records the high-water)
+    act_lock = threading.Lock()
+    act = {"n": 0, "hw": 0}
+
+    def _act_enter():
+        with act_lock:
+            act["n"] += 1
+            if act["n"] > act["hw"]:
+                act["hw"] = act["n"]
+
+    def _act_exit():
+        with act_lock:
+            act["n"] -= 1
+
+    # spans the pool threads begin parent under THIS run's span by
     # explicit handoff (thread-local nesting does not cross threads):
     # the exported timeline then shows ingest slabs under the run that
     # caused them, overlapping the main thread's compute slabs
     run_sp = _obs.begin("stream.run", terminal=terminal, depth=depth,
-                        kind=source.kind)
+                        uploaders=nwork, kind=source.kind)
 
-    def feeder():
-        slab_i = 0
+    jobq = queue.Queue()
+
+    def dispenser():
+        """Callback sources: hand (slab_i, lo, hi) index jobs to the
+        uploader pool in slab order; workers produce AND upload their
+        own slabs concurrently (random access makes that safe)."""
+        try:
+            i = 0
+            for lo, hi in source.slab_ranges():
+                if not _acquire(permits, stop):
+                    return
+                jobq.put((i, lo, hi))
+                i += 1
+            rsq.finish(i)
+        except BaseException as exc:        # noqa: BLE001 — re-raised in
+            rsq.fault(exc)                  # the consumer thread
+        finally:
+            for _ in range(nwork):
+                jobq.put(None)              # poison pills: pool drains
+
+    def worker(wid):
+        try:
+            while True:
+                job = jobq.get()
+                if job is None or stop.is_set():
+                    return
+                i, lo, hi = job
+                _act_enter()
+                sp = _obs.begin("stream.ingest", parent=run_sp, slab=i,
+                                worker=wid)
+                t0 = _clock()
+                try:
+                    block = source.produce_slab(lo, hi)
+                    buf = _upload_slab(block, mesh, split)
+                    tsec = _clock() - t0
+                    if sp is not None:
+                        sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
+                finally:
+                    _obs.end(sp)
+                    _act_exit()
+                del block
+                rsq.put(i, (buf, tsec))
+        except BaseException as exc:        # noqa: BLE001 — re-raised in
+            rsq.fault(exc)                  # the consumer thread
+
+    def prefetch():
+        """Iterator sources: ONE produce+upload thread (the iterable is
+        sequential; concurrent ``next()`` would corrupt it).  The ingest
+        span/time covers produce AND upload, like a worker's."""
+        i = 0
         try:
             it = source.slabs()
             while True:
                 if stop.is_set():
                     return
-                sp = _obs.begin("stream.ingest", parent=run_sp,
-                                slab=slab_i)
+                if not _acquire(permits, stop):
+                    return
+                _act_enter()
+                sp = _obs.begin("stream.ingest", parent=run_sp, slab=i)
                 t0 = _clock()
                 try:
                     try:
@@ -712,49 +1024,86 @@ def execute(arr, terminal, ddof=None, rfunc=None):
                     except StopIteration:
                         _obs.cancel(sp)     # probe saw end-of-source
                         sp = None
+                        permits.release()   # unused hand-slot permit
                         break
-                    buf = transfer(
-                        block,
-                        key_sharding(mesh, block.shape, split), wait=True)
+                    buf = _upload_slab(block, mesh, split)
                     tsec = _clock() - t0
                     if sp is not None:
                         sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
                 finally:
                     _obs.end(sp)
+                    _act_exit()
                 del block
-                slab_i += 1
-                if not _put(q, (buf, tsec), stop):
-                    return
-            _put(q, _DONE, stop)
-        except BaseException as exc:        # noqa: BLE001 — re-raised in
-            _put(q, _StreamFault(exc), stop)  # the consumer thread
+                rsq.put(i, (buf, tsec))
+                i += 1
+            rsq.finish(i)
+        except BaseException as exc:        # noqa: BLE001
+            rsq.fault(exc)
 
-    th = threading.Thread(target=feeder, name="bolt-stream-prefetch",
-                          daemon=True)
-    _LAST_THREAD = th
+    if source.kind == "callback":
+        lead = threading.Thread(target=dispenser,
+                                name="bolt-stream-prefetch", daemon=True)
+        pool = [threading.Thread(target=worker, args=(w,),
+                                 name="bolt-stream-upload-%d" % w,
+                                 daemon=True)
+                for w in range(nwork)]
+        threads = [lead] + pool
+        ingesters = pool               # only workers deliver slabs
+    else:
+        lead = threading.Thread(target=prefetch,
+                                name="bolt-stream-prefetch", daemon=True)
+        threads = [lead]
+        ingesters = threads
+    _LAST_THREAD = lead
+    _LAST_POOL = tuple(threads)
+
     t_start = _clock()
     ingest = 0.0
     compute = 0.0
     nslabs = 0
     fold = None
-    th.start()
+    pend = None                 # even slab's partial awaiting its pair
+    pending_sync = deque()      # (slabs covered, partial) not confirmed
+    dispatched = 0
+    confirmed = 0
+    inflight_hw = 0
+
+    def _fold_push(part):
+        nonlocal fold
+        if fold is None:
+            # pair-partials fold as a PAIRWISE tree for every terminal —
+            # the moments merge included, so power-of-two slab counts
+            # keep the Chan denominators exact (level 0 is fused into
+            # the odd slab programs; this tree is level 1 and up).
+            # Capture only shape/dtype: a factory closing over the live
+            # partial would pin its device buffers for the whole run.
+            if terminal in ("sum", "reduce"):
+                shape, dtype = part.shape, part.dtype
+                fold = _PairFold(lambda: _merge_program(
+                    terminal, shape, dtype, rfunc, mesh))
+            else:
+                mshape, mdtype = part[1].shape, part[1].dtype
+
+                def factory():
+                    mp = _merge_program(terminal, mshape, mdtype, None,
+                                        mesh)
+                    return lambda a, b: tuple(mp(*a, *b))
+                fold = _PairFold(factory)
+        fold.push(part)
+
+    for th in threads:
+        th.start()
     try:
         try:
             while True:
-                item = q.get()
-                if item is _DONE:
+                got = rsq.next(threads, workers=ingesters)
+                if got is None:
                     break
-                if isinstance(item, _StreamFault):
-                    # clean abort: join the prefetch thread, release the
-                    # ring, discard partials, re-raise the ORIGINAL error
-                    raise item.exc
-                buf, tsec = item
+                slab_i, (buf, tsec) = got
                 ingest += tsec
                 t0 = _clock()
-                csp = _obs.begin("stream.compute", slab=nslabs)
+                csp = _obs.begin("stream.compute", slab=slab_i)
                 try:
-                    prog = _slab_program(source, terminal, buf.shape,
-                                         ddof, rfunc)
                     with warnings.catch_warnings():
                         # backends without donation (the CPU dev mesh)
                         # warn that the donated slab buffer was unusable
@@ -763,42 +1112,68 @@ def execute(arr, terminal, ddof=None, rfunc=None):
                         warnings.filterwarnings(
                             "ignore",
                             message="Some donated buffers were not usable")
-                        part = prog(buf)
-                    del buf, item          # the donated ring slot is free
-                    jax.block_until_ready(part)
+                        if pend is None:
+                            prog = _slab_program(source, terminal,
+                                                 buf.shape, ddof, rfunc)
+                            pend = prog(buf)
+                        else:
+                            # level-0 fold fused into the slab dispatch
+                            prog = _slab_program(source, terminal,
+                                                 buf.shape, ddof, rfunc,
+                                                 fused=True)
+                            pairp = prog(buf, pend)
+                            pend = None
+                            _fold_push(pairp)
+                            pending_sync.append((2, pairp))
+                    del buf, got           # the donated ring slot is free
                 finally:
                     _obs.end(csp)
                 compute += _clock() - t0
-                fsp = _obs.begin("stream.fold", slab=nslabs)
-                try:
-                    if fold is None:
-                        # partials fold as a PAIRWISE tree for every
-                        # terminal — the moments merge included, so
-                        # power-of-two slab counts keep the Chan
-                        # denominators exact
-                        if terminal in ("sum", "reduce"):
-                            fold = _PairFold(_merge_program(
-                                terminal, part.shape, part.dtype, rfunc,
-                                mesh))
-                        else:
-                            mp = _merge_program(terminal, part[1].shape,
-                                                part[1].dtype, None, mesh)
-                            fold = _PairFold(
-                                lambda a, b: tuple(mp(*a, *b)))
-                    fold.push(part)
-                finally:
-                    _obs.end(fsp)
                 nslabs += 1
+                dispatched += 1
+                if dispatched - confirmed > inflight_hw:
+                    inflight_hw = dispatched - confirmed
+                # bounded in-flight window: NO per-slab sync — only once
+                # the window fills does the consumer block, and then on
+                # the OLDEST pair partial, dispatched ~window slabs ago
+                # and normally long retired (a ~free wait that releases
+                # its ring permits)
+                while dispatched - confirmed > window and pending_sync:
+                    cov, ref = pending_sync.popleft()
+                    ssp = _obs.begin("stream.sync", slabs=cov)
+                    t0 = _clock()
+                    try:
+                        jax.block_until_ready(ref)
+                    finally:
+                        _obs.end(ssp)
+                    compute += _clock() - t0
+                    confirmed += cov
+                    permits.release(cov)
+            if pend is not None:
+                # odd slab count: the unpaired tail partial joins the
+                # tree as its own leaf (deterministic — slab order only)
+                _fold_push(pend)
+                pend = None
         finally:
             stop.set()
-            th.join()
-            while True:                   # release queued ring buffers
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
+            # the consumer's OWN poison pills: if the dispenser was
+            # killed before its finally could enqueue them, workers sit
+            # blocked in jobq.get() forever and the joins below would
+            # reproduce the very hang the liveness guard reports —
+            # extra pills are harmless (workers exit on the first one)
+            for _ in range(len(threads)):
+                jobq.put(None)
+            for th in threads:
+                th.join()
+            rsq.drain()                   # release queued ring buffers
+            pending_sync.clear()
 
+        if fold is None:
+            raise RuntimeError(
+                "stream produced no slabs (empty source?) — nothing to "
+                "reduce; the materialised path owns empty-input rules")
         fsp = _obs.begin("stream.fold", final=True)
+        t0 = _clock()
         try:
             if terminal in ("sum", "reduce"):
                 out = fold.result()
@@ -806,17 +1181,22 @@ def execute(arr, terminal, ddof=None, rfunc=None):
                 n, mu, m2 = fold.result()
                 out = _finalise_program(terminal, mu.shape, mu.dtype,
                                         ddof, mesh)(n, mu, m2)
+            # the ONE synchronisation point of the whole run
             out.block_until_ready()
         finally:
             _obs.end(fsp)
+        compute += _clock() - t0
         wall = _clock() - t_start
         overlap = max(0.0, ingest + compute - wall)
         _engine.record_stream(nslabs, ingest, compute, wall, overlap,
-                              depth)
+                              depth, uploaders=max(act["hw"], 1),
+                              inflight=max(inflight_hw, 1))
         if run_sp is not None:
             run_sp.set(slabs=nslabs, ingest_s=round(ingest, 6),
                        compute_s=round(compute, 6),
-                       overlap_s=round(overlap, 6))
+                       overlap_s=round(overlap, 6),
+                       concurrent_uploaders=max(act["hw"], 1),
+                       inflight_high_water=max(inflight_hw, 1))
         return BoltArrayTPU(out, 0, mesh)
     finally:
         _obs.end(run_sp)

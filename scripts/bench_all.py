@@ -125,10 +125,14 @@ def pipelines(mesh=None, nkeys=16):
     k = nkeys
     x2 = (np.abs(rs.randn(k, 6, 4)) + 0.5).astype(np.float32)
     x4 = rs.randn(k, 6, 4).astype(np.float32)
-    # config 6's lazy out-of-core source: nothing uploads during the
-    # check — the streaming plan is interpreted abstractly
+    # configs 6/7's lazy out-of-core sources: nothing uploads during the
+    # check — the streaming plans are interpreted abstractly
     x6 = np.ones((k, 8, 4), np.float32)
     stream6 = bolt.fromcallback(lambda idx: x6[idx], (k, 8, 4), mesh,
+                                dtype=np.float32, chunks=max(1, k // 4))
+    x7 = (np.arange(k * 8 * 4, dtype=np.int64) % 7).astype(
+        np.float32).reshape(k, 8, 4)
+    stream7 = bolt.fromcallback(lambda idx: x7[idx], (k, 8, 4), mesh,
                                 dtype=np.float32, chunks=max(1, k // 4))
     return [
         ("1 map->sum", bolt.array(np.ones((k, 8, 4), np.float32),
@@ -143,6 +147,7 @@ def pipelines(mesh=None, nkeys=16):
             mesh).map(ADD1).chunk(size=(8,), axis=(0,))),
         ("6 stream chunked map->sum",
          stream6.chunk(size=(4,), axis=(0,)).map(ADD1)),
+        ("7 stream_sum_parallel", stream7.map(ADD1)),
     ]
 
 
@@ -182,6 +187,29 @@ def check_configs(mesh=None):
               % (pred, rep.dtype, got_shape, got_dtype, compiled, leaked,
                  "OK" if ok else "MISMATCH"))
         failed = failed or not ok
+        if name.startswith("7"):
+            # the parallel-ingest executor gate (ISSUE 5): stream the
+            # terminal through an uploader pool TWICE — the per-slab
+            # executable (and its acc-fused level-0 twin) must compile
+            # exactly once, so the second pass adds ZERO compiles; and
+            # the pool run must leak no spans
+            from bolt_tpu import stream as _stream
+            with _stream.uploaders(2):
+                arr.sum()                    # first pass compiles
+                c0 = engine.counters()
+                arr.sum()
+                c1 = engine.counters()
+            recompiled = (c1["misses"] - c0["misses"]
+                          + c1["aot_compiles"] - c0["aot_compiles"])
+            leaked7 = obs.active_count()
+            ok7 = (recompiled == 0 and leaked7 == 0
+                   and c1["stream_upload_threads"] >= 1)
+            print("   streamed twice via uploader pool: recompiles on "
+                  "2nd pass: %d | leaked spans: %d | uploader "
+                  "high-water: %d -> %s"
+                  % (recompiled, leaked7, c1["stream_upload_threads"],
+                     "OK" if ok7 else "MISMATCH"))
+            failed = failed or not ok7
     obs.disable()
     return 1 if failed else 0
 
@@ -425,6 +453,37 @@ def main():
     ok6 = allclose(lo6, np.asarray(to6.toarray()), rtol=1e-4, atol=1e-4)
     rows.append(_progress("6 stream_sum 0.5GB ingest", lt6, tt6,
                           "allclose" if ok6 else "MISMATCH"))
+
+    # ---- config 7: parallel-ingest streamed sum (ISSUE 5) ------------
+    # the same out-of-core workload as config 6 through the N-way
+    # uploader pool + async dispatch: workers produce AND upload slabs
+    # concurrently (per-device sub-blocks), slab programs dispatch into
+    # the bounded in-flight window with the level-0 fold fused in.  The
+    # counter deltas prove the pipeline: >1 concurrent uploader and
+    # ~half the dispatches per slab of the pre-pool executor.
+    from bolt_tpu import stream as _stream
+    with _stream.uploaders(4):
+        sync(launch6())                       # warm the pool-run programs
+        c0 = _profile.engine_counters()
+        t0 = time.perf_counter()
+        to7 = launch6()
+        sync(to7)
+        tt7 = time.perf_counter() - t0
+        c1 = _profile.engine_counters()
+    dl = {k: c1[k] - c0[k] for k in c1}
+    eff7 = (dl["stream_overlap_seconds"] / dl["stream_ingest_seconds"]
+            if dl["stream_ingest_seconds"] else 0.0)
+    print("   stream_sum_parallel: %d slabs, %.0f MB shipped, "
+          "concurrent uploaders (hw) %d, in-flight hw %d, "
+          "dispatches/slab %.2f, overlap_efficiency %.2f"
+          % (dl["stream_chunks"], dl["transfer_bytes"] / 1e6,
+             c1["stream_upload_threads"],
+             c1["stream_inflight_high_water"],
+             dl["dispatches"] / max(dl["stream_chunks"], 1), eff7),
+          file=sys.stderr)
+    ok7 = allclose(lo6, np.asarray(to7.toarray()), rtol=1e-4, atol=1e-4)
+    rows.append(_progress("7 stream_sum_parallel", lt6, tt7,
+                          "allclose" if ok7 else "MISMATCH"))
 
     print("%-26s %10s %10s %9s  %s" % ("config", "local s", "tpu s", "speedup", "parity"))
     for name, lt, tt, parity in rows:

@@ -331,15 +331,18 @@ def fam_jacobi_eigh():
 
 
 def fam_stream_sum():
-    # the ISSUE-3 streaming out-of-core executor: host-resident data
-    # streamed slab-by-slab through the double-buffered prefetch
-    # pipeline into a fused per-slab map+sum (slab buffers donated, the
-    # ring recycles).  This family gauges the host->device INGEST link
-    # with compute overlapped — transfer-bound by design, so regressions
-    # here mean the pipeline stopped hiding the upload (the chip-side
-    # program itself is fam_map_sum's).  The s_per_iter is one full
-    # streamed pass, not a queued steady-state launch: streamed runs are
-    # synchronous end-to-end.
+    # the streaming out-of-core executor, ISSUE-5 form: host-resident
+    # data streamed through the N-way UPLOADER POOL (workers produce and
+    # upload slabs concurrently as per-device sub-blocks, a re-sequencer
+    # keeps the fold in slab order), slab programs dispatched ASYNC into
+    # the bounded in-flight window with the level-0 fold fused in (slab
+    # buffers donated, the ring recycles).  This family gauges the
+    # host->device INGEST link with compute overlapped — transfer-bound
+    # by design, so regressions here mean the pipeline stopped hiding
+    # the upload (the chip-side program itself is fam_map_sum's).  The
+    # s_per_iter is one full streamed pass, not a queued steady-state
+    # launch: a streamed run syncs once, on its final result.
+    from bolt_tpu import stream as _stream
     shape = (4096, 256, 64)                       # 0.27 GB over the link
     x = (np.arange(np.prod(shape), dtype=np.int64) % 251).astype(
         np.float32).reshape(shape)
@@ -349,20 +352,29 @@ def fam_stream_sum():
                                 dtype=np.float32, chunks=512)
         return src.chunk(size=(64,), axis=(0,)).map(MAPSUM_FN).sum()
 
-    jax.device_get(_tiny(run()))                  # compile slab programs
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        jax.device_get(_tiny(run()))
-        best = min(best, time.perf_counter() - t0)
+    with _stream.uploaders(4):
+        jax.device_get(_tiny(run()))              # compile slab programs
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.device_get(_tiny(run()))
+            best = min(best, time.perf_counter() - t0)
     eff = bolt.profile.overlap_efficiency()
+    ec = bolt.profile.engine_counters()
     return int(np.prod(shape)) * 4, best, {
         "bound": "transfer",
         "overlap_efficiency": round(eff, 3),
-        "traffic": (1.0, "one host->device pass per byte, overlapped "
-                         "with one fused on-device map+sum read pass; "
-                         "partials merge on device, one value block "
-                         "returns")}
+        # the parallel-ingest pipeline's shape, recorded with the number
+        # (ISSUE 5): configured pool 4, the OBSERVED concurrent-uploader
+        # high-water, and the async dispatch window's peak
+        "upload_threads": ec["stream_upload_threads"],
+        "inflight_high_water": ec["stream_inflight_high_water"],
+        "prefetch_depth": ec["stream_prefetch_depth"],
+        "traffic": (1.0, "one host->device pass per byte through the "
+                         "uploader pool, overlapped with one fused "
+                         "on-device map+sum read pass; level-0 fold "
+                         "fused into the slab dispatch, pair partials "
+                         "merge on device, one value block returns")}
 
 
 def fam_pca_default():
@@ -502,7 +514,16 @@ def main():
         meta = out[2] if len(out) > 2 else {"bound": "hbm"}
         gbps = nbytes / sec / 1e9
         entry = {"s_per_iter": round(sec, 5), "bytes": nbytes,
-                 "gbps": round(gbps, 1), "bound": meta["bound"]}
+                 "gbps": round(gbps, 1), "bound": meta["bound"],
+                 # which backend actually measured this window: chip
+                 # numbers and cpu-container numbers must never be
+                 # confused when read back (low-water marks are per
+                 # platform in spirit)
+                 "platform": jax.default_backend()}
+        for key in ("upload_threads", "inflight_high_water",
+                    "prefetch_depth"):
+            if meta.get(key) is not None:
+                entry[key] = meta[key]
         if phases:
             # --trace mode: span-derived per-phase wall totals for the
             # family (engine.lower/compile vs dispatch vs stream
@@ -567,6 +588,8 @@ def main():
         "transfer_bytes": ec["transfer_bytes"],
         "transfer_seconds": round(ec["transfer_seconds"], 3),
         "stream_chunks": ec["stream_chunks"],
+        "stream_upload_threads": ec["stream_upload_threads"],
+        "stream_inflight_high_water": ec["stream_inflight_high_water"],
         "overlap_efficiency": round(
             bolt.profile.overlap_efficiency(ec), 4),
     }

@@ -303,6 +303,7 @@ _EXPECTED_ENGINE_KEYS = {
     "stream_chunks": False, "stream_ingest_seconds": True,
     "stream_compute_seconds": True, "stream_wall_seconds": True,
     "stream_overlap_seconds": True, "stream_prefetch_depth": False,
+    "stream_upload_threads": False, "stream_inflight_high_water": False,
 }
 
 

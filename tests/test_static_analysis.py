@@ -529,6 +529,33 @@ def test_lint_blt104_concrete_bypass():
 
 
 @pytest.mark.lint
+def test_lint_blt107_stray_sync_points():
+    # method form: x.block_until_ready()
+    src = "def f(x):\n    return x.block_until_ready()\n"
+    assert [x.code for x in astlint.lint_source(
+        src, "bolt_tpu/ops/foo.py")] == ["BLT107"]
+    # module-function form: jax.block_until_ready(tree)
+    src2 = "import jax\n\ndef f(t):\n    return jax.block_until_ready(t)\n"
+    assert [x.code for x in astlint.lint_source(
+        src2, "bolt_tpu/tpu/chunk.py")] == ["BLT107"]
+    # from-import form
+    src3 = ("from jax import block_until_ready\n\n"
+            "def f(t):\n    return block_until_ready(t)\n")
+    assert any(x.code == "BLT107" for x in astlint.lint_source(
+        src3, "bolt_tpu/tpu/stack.py"))
+    # the sanctioned sync owners are exempt
+    for home in ("bolt_tpu/stream.py", "bolt_tpu/engine.py",
+                 "bolt_tpu/profile.py"):
+        assert astlint.lint_source(src, home) == []
+        assert astlint.lint_source(src2, home) == []
+    # path anchoring: upstream.py does not inherit stream.py's pass
+    assert any(x.code == "BLT107" for x in astlint.lint_source(
+        src, "bolt_tpu/upstream.py"))
+    # and the whole package lints clean with the rule armed
+    assert astlint.lint_package() == []
+
+
+@pytest.mark.lint
 def test_lint_cli_check_mode_passes_on_repo():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "lint_bolt.py"),

@@ -301,19 +301,24 @@ def test_stream_counters_and_compile_once(mesh):
     assert d["stream_chunks"] == 4
     assert d["transfer_bytes"] == data.nbytes
     assert c1["stream_prefetch_depth"] >= 1
-    # EXACTLY one per-slab executable and one merge program: misses and
-    # AOT compiles are 2, dispatches are 4 slabs + 3 pairwise merges
-    assert d["misses"] == 2 and d["aot_compiles"] == 2
-    assert d["dispatches"] == 4 + 3
+    assert c1["stream_upload_threads"] >= 1
+    assert c1["stream_inflight_high_water"] >= 1
+    # EXACTLY one executable per program: the per-slab partial (even
+    # slabs), its acc-fused twin (odd slabs — the level-0 fold fused
+    # into the slab dispatch), and ONE tree merge.  Dispatches are
+    # 4 slabs + 1 level-1 merge — the level-0 merges cost nothing,
+    # vs 4 + 3 before the fusion (>= 2x fewer fold dispatches).
+    assert d["misses"] == 3 and d["aot_compiles"] == 3
+    assert d["dispatches"] == 4 + 1
     assert d["stream_ingest_seconds"] > 0
     assert d["stream_wall_seconds"] > 0
-    # a second identical run reuses BOTH executables: zero new compiles
+    # a second identical run reuses ALL executables: zero new compiles
     c2 = engine.counters()
     out2 = _source(data, mesh, 3).map(add_one).sum()
     c3 = engine.counters()
     d2 = {k: c3[k] - c2[k] for k in c3}
     assert d2["misses"] == 0 and d2["aot_compiles"] == 0
-    assert d2["dispatches"] == 4 + 3
+    assert d2["dispatches"] == 4 + 1
     assert np.array_equal(np.asarray(out.toarray()),
                           np.asarray(out2.toarray()))
 
@@ -556,6 +561,186 @@ def test_blt105_device_put_rule():
     assert not astlint.lint_source(bad, "bolt_tpu/stream.py")
     # and the whole package still lints clean (BLT105 included)
     assert astlint.lint_package() == []
+
+
+# ---------------------------------------------------------------------
+# parallel ingest (ISSUE 5): the uploader pool, slab-order
+# re-sequencing, the async in-flight window, and pool fault paths
+# ---------------------------------------------------------------------
+
+def test_uploaders_scope_and_pool_size(mesh):
+    data = _intdata()
+    src = _source(data, mesh, 4)._stream
+    before = stream.upload_threads()
+    try:
+        stream.set_upload_threads(0)            # auto
+        assert stream.pool_size(src) == min(len(mesh.devices.ravel()), 4)
+        with stream.uploaders(7):
+            assert stream.upload_threads() == 7
+            assert stream.pool_size(src) == 7
+        assert stream.upload_threads() == 0
+        stream.set_upload_threads(2)
+        assert stream.pool_size(src) == 2
+        # sequential sources always stream through ONE prefetch thread
+        it = bolt.fromiter([data], SHAPE, mesh, dtype=np.float64)._stream
+        with stream.uploaders(6):
+            assert stream.pool_size(it) == 1
+    finally:
+        stream.set_upload_threads(before)
+
+
+def test_stream_concurrent_uploaders_counted(mesh):
+    # two workers provably ingest AT THE SAME TIME: the loader blocks at
+    # a 2-party barrier, so two pool threads must be mid-ingest together
+    # before either can finish — the counter records that high-water
+    data = _intdata()
+    bar = threading.Barrier(2, timeout=20)
+
+    def loader(idx):
+        try:
+            bar.wait()
+        except threading.BrokenBarrierError:
+            pass                                # odd tail: proceed alone
+        return data[idx]
+
+    src = bolt.fromcallback(loader, SHAPE, mesh, dtype=np.float64,
+                            chunks=4)           # 4 slabs, pool >= 2
+    c0 = engine.counters()
+    with stream.uploaders(2):
+        got = np.asarray(src.sum().toarray())
+    c1 = engine.counters()
+    assert np.array_equal(got, data.sum(axis=0))
+    assert c1["stream_upload_threads"] >= 2     # > 1 concurrent uploader
+    assert c1["stream_inflight_high_water"] >= 1
+
+
+def test_stream_sharded_multidevice_parity_bitexact(mesh):
+    # slabs that REALLY shard: 32 records, slabs of 8 over the 8-way
+    # mesh — each device uploads its own sub-block of every slab via the
+    # per-device placement path.  Integer-valued data: sum/mean must be
+    # BIT-identical to the materialised path; var/std at f64 tolerance.
+    n = 32
+    data = ((np.arange(n * V0 * V1) % 17) - 8).astype(
+        np.float64).reshape(n, V0, V1)
+    mat = bolt.array(data, mesh)
+    for chunks in (8, 16):                      # power-of-two slab counts
+        for name in ("sum", "mean"):
+            got = np.asarray(getattr(_source(data, mesh, chunks),
+                                     name)().toarray())
+            want = np.asarray(getattr(mat, name)().toarray())
+            assert np.array_equal(got, want), (name, chunks)
+    for chunks in (5, 1):                       # uneven tail + 1-record
+        for name, tol in (("sum", 0.0), ("var", 1e-12), ("std", 1e-12)):
+            got = np.asarray(getattr(_source(data, mesh, chunks),
+                                     name)().toarray())
+            want = np.asarray(getattr(mat, name)().toarray())
+            if tol:
+                assert np.allclose(got, want, rtol=tol, atol=tol), \
+                    (name, chunks)
+            else:
+                assert np.array_equal(got, want), (name, chunks)
+
+
+def test_stream_out_of_order_upload_folds_in_slab_order(mesh, monkeypatch):
+    # slab 0's upload is HELD BACK until another slab has finished: the
+    # re-sequencer must still hand slabs to the fold in slab order, so
+    # the result stays bit-identical to the materialised path
+    data = _intdata()
+    orig = stream._upload_slab
+    done = []
+
+    def held_back(block, mesh_, split):
+        lo = int(block[0, 0, 0] == data[0, 0, 0] and
+                 np.array_equal(block, data[:block.shape[0]]))
+        if lo:                                  # slab 0: wait for a peer
+            t0 = time.time()
+            while not done and time.time() - t0 < 10:
+                time.sleep(0.002)
+        out = orig(block, mesh_, split)
+        done.append(lo)
+        return out
+
+    monkeypatch.setattr(stream, "_upload_slab", held_back)
+    with stream.uploaders(3):
+        got = np.asarray(_source(data, mesh, 4).mean().toarray())
+    assert done and done[0] == 0                # slab 0 finished LATE
+    assert 1 in done
+    want = np.asarray(bolt.array(data, mesh).mean().toarray())
+    assert np.array_equal(got, want)            # fold order unaffected
+
+
+def test_stream_fault_in_uploader_worker_aborts_cleanly(mesh,
+                                                        monkeypatch):
+    # a raise inside ONE pool worker (not the source callback): the
+    # whole pool is joined, ring permits are released, and the ORIGINAL
+    # exception re-raises in the consumer
+    data = _intdata()
+    boom = RuntimeError("device link dropped")
+    orig = stream._upload_slab
+    calls = []
+
+    def flaky_upload(block, mesh_, split):
+        calls.append(block.shape)
+        if len(calls) == 2:
+            raise boom
+        return orig(block, mesh_, split)
+
+    monkeypatch.setattr(stream, "_upload_slab", flaky_upload)
+    src = _source(data, mesh, 4)
+    with stream.uploaders(2):
+        with pytest.raises(RuntimeError) as ei:
+            src.sum()
+    assert ei.value is boom                     # the ORIGINAL exception
+    # the WHOLE pool (dispenser + workers) is joined, nothing leaks
+    assert stream._LAST_POOL
+    assert all(not t.is_alive() for t in stream._LAST_POOL)
+    # the executor is not poisoned: a healthy stream runs right after
+    monkeypatch.setattr(stream, "_upload_slab", orig)
+    ok = np.asarray(_source(data, mesh, 4).sum().toarray())
+    assert np.array_equal(ok, data.sum(axis=0))
+
+
+def test_stream_dead_pool_thread_raises_pointed_error(mesh, monkeypatch):
+    # the q.get()-blocks-forever bug: a pool thread that dies WITHOUT
+    # enqueueing anything (teardown-killed before its fault handler ran)
+    # must surface as a pointed RuntimeError naming the dead thread, not
+    # hang the consumer.  Simulated by muting the fault funnel.
+    data = _intdata()
+    monkeypatch.setattr(stream._Reseq, "fault",
+                        lambda self, exc: None)
+
+    def dying(idx):
+        raise RuntimeError("this error is swallowed by the mute")
+
+    src = bolt.fromcallback(dying, SHAPE, mesh, dtype=np.float64,
+                            chunks=4)
+    with pytest.raises(RuntimeError, match="died without delivering"):
+        src.sum()
+    with pytest.raises(RuntimeError, match="bolt-stream"):
+        bolt.fromcallback(dying, SHAPE, mesh, dtype=np.float64,
+                          chunks=4).sum()
+    # the harder shape: MORE slabs than the ring, so the dispenser is
+    # still alive, blocked on ring permits, when every worker dies —
+    # dead workers must trip the guard anyway (nothing can ever arrive)
+    with stream.uploaders(2), stream.prefetch(1):   # ring 3 << 16 slabs
+        with pytest.raises(RuntimeError, match="died without delivering"):
+            bolt.fromcallback(dying, SHAPE, mesh, dtype=np.float64,
+                              chunks=1).sum()
+
+
+def test_stream_inflight_window_bounds_and_records(mesh):
+    # a long stream (16 one-record slabs, depth 1, one uploader) must
+    # keep the in-flight window bounded by the ring and record the
+    # high-water; the ring permits keep cycling (no deadlock, exact sum)
+    data = _intdata()
+    c0 = engine.counters()
+    with stream.prefetch(1), stream.uploaders(1):
+        got = np.asarray(_source(data, mesh, 1).sum().toarray())
+    c1 = engine.counters()
+    assert np.array_equal(got, data.sum(axis=0))
+    assert c1["stream_inflight_high_water"] >= 1
+    d = {k: c1[k] - c0[k] for k in c1}
+    assert d["stream_chunks"] == 16
 
 
 # ---------------------------------------------------------------------
