@@ -70,8 +70,11 @@ def bench_tpu(shape, pipe_iters=50):
 
     def launch():
         # map defers; sum fuses the chain into one compiled pass over HBM;
-        # dispatch is async — the returned array's buffer is a future
-        return b.map(mapper, axis=(0,)).sum(axis=axes)
+        # dispatch is async — the returned array's buffer is a future.
+        # cache() forces the LAZY terminal to dispatch (stat results are
+        # pending fused-group handles now); the dispatch itself stays
+        # async, so launches still pipeline
+        return b.map(mapper, axis=(0,)).sum(axis=axes).cache()
 
     out = float(launch().toarray())  # compile + warm caches
 

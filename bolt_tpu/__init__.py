@@ -20,13 +20,14 @@ from bolt_tpu.factory import (array, concatenate, fromcallback, fromiter,
 from bolt_tpu.base import BoltArray, HostFallbackWarning
 from bolt_tpu.local.array import BoltArrayLocal
 from bolt_tpu.tpu.array import BoltArrayTPU
+from bolt_tpu.tpu.multistat import compute
 from bolt_tpu._precision import precision
 from bolt_tpu.utils import allclose
 
 __all__ = ["array", "ones", "zeros", "full", "rand", "randn",
-           "fromcallback", "fromiter", "concatenate", "allclose",
-           "precision", "BoltArray", "BoltArrayLocal", "BoltArrayTPU",
-           "HostFallbackWarning", "__version__"]
+           "fromcallback", "fromiter", "concatenate", "compute",
+           "allclose", "precision", "BoltArray", "BoltArrayLocal",
+           "BoltArrayTPU", "HostFallbackWarning", "__version__"]
 
 _SUBMODULES = ("analysis", "checkpoint", "engine", "obs", "profile",
                "parallel", "ops", "statcounter", "stream", "utils")

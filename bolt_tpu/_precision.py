@@ -89,3 +89,76 @@ def resolve(explicit=None, pinned="highest"):
     if st:
         return st[-1]
     return pinned
+
+
+# ---------------------------------------------------------------------
+# reduced-precision ACCUMULATION for the fused multi-stat reductions
+# (bolt.compute / stats(...) — bolt_tpu/tpu/multistat.py).  A separate
+# axis from the matmul precision above: matmul precision picks the MXU
+# pass count, accumulation mode picks the value/accumulator dtypes of
+# the additive reduction terminals (sum/prod/mean/var/std).
+#
+# - None (default): bit-identical to the standalone terminals — the
+#   fused program traces exactly the standalone expressions.
+# - "f32": values cast to float32 before reducing (results float32).
+#   For float32 pipelines this is EXACTLY the default arithmetic
+#   (parity-locked bit-identical in tests); for float64 pipelines it is
+#   the documented downcast (~1e-7 relative).
+# - "bf16": values cast to bfloat16, accumulated in float32 (the
+#   accumulate-in-f32 contract; results float32).  Halves the read
+#   bytes of a bf16-resident pipeline and keeps the documented ~1e-2
+#   relative accuracy envelope (parity-locked at that tolerance in
+#   tests/test_multistat.py).
+#
+# min/max/any/all (and the min/max pair behind ptp) are exact order
+# statistics and ignore the mode.  Scoped like bolt.precision
+# (thread-local, innermost wins); the per-call door is
+# ``bolt.compute(..., accumulate=...)``.
+# ---------------------------------------------------------------------
+
+ACCUMULATE_MODES = ("bf16", "f32")
+
+_acc_tls = threading.local()
+
+
+def _check_accumulate(mode):
+    if mode is None:
+        return None
+    if isinstance(mode, str) and mode.lower() in ACCUMULATE_MODES:
+        return mode.lower()
+    raise ValueError(
+        "accumulate mode must be one of %r or None (got %r)"
+        % (ACCUMULATE_MODES, mode))
+
+
+@contextmanager
+def accumulate(mode):
+    """Scoped reduced-precision accumulation for fused multi-stat
+    reductions::
+
+        with bolt_tpu._precision.accumulate("bf16"):
+            s, v = bolt.compute(b.sum(), b.var())
+
+    ``accumulate(None)`` restores the exact default inside the scope.
+    Nests (innermost wins); defaults are unchanged outside any scope."""
+    mode = _check_accumulate(mode)
+    st = getattr(_acc_tls, "stack", None)
+    if st is None:
+        st = _acc_tls.stack = []
+    st.append(mode)
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def resolve_accumulate(explicit=None):
+    """The effective accumulation mode for one fused dispatch:
+    ``explicit`` (``bolt.compute(..., accumulate=...)``) > innermost
+    active :func:`accumulate` scope > ``None`` (exact, the default)."""
+    if explicit is not None:
+        return _check_accumulate(explicit)
+    st = getattr(_acc_tls, "stack", None)
+    if st:
+        return st[-1]
+    return None

@@ -115,6 +115,84 @@ def _spec(mesh, shape, split):
         return None
 
 
+def _fmt_bytes(n):
+    if n >= 1 << 30:
+        return "%.1f GB" % (n / float(1 << 30))
+    if n >= 1 << 20:
+        return "%.1f MB" % (n / float(1 << 20))
+    return "%d B" % n
+
+
+def _group_bytes(g):
+    """Bytes ONE pass of a stat group reads (the fusion forecast's
+    bytes-read model)."""
+    if g.kind == "chain":
+        return int(g.base.nbytes)
+    if g.kind == "fpending":
+        return int(g.fpending[0].nbytes)
+    return prod(g.source.shape) * np.dtype(g.source.dtype).itemsize
+
+
+def _note_fusable(arr, idx, diags):
+    """``BLT009``: forecast the single-pass fusion — this array's
+    source carries a live fused stat group (bolt_tpu/tpu/multistat.py),
+    so its pending terminals will resolve from ONE read instead of one
+    pass each.  ``explain()`` thereby shows the single-pass plan and
+    the bytes-read estimate before anything dispatches."""
+    g = getattr(arr, "_stat_group", None)
+    if g is not None:
+        _note_fusable_group(g, idx, diags)
+
+
+def _check_spending(arr, target, stages, diags):
+    """Abstractly interpret a PENDING STAT array (the lazy result of a
+    ``sum()``-family terminal): nothing dispatches — the group's source
+    and the terminal's derived aval are reported, plus the ``BLT009``
+    fusion forecast."""
+    h = arr._spending
+    g = h.group
+    if g.kind == "stream":
+        src_shape = tuple(g.source.shape)
+        src_dtype = np.dtype(g.source.dtype)
+        label = "stream source (%s)" % g.source.kind
+    elif g.kind == "fpending":
+        base = g.fpending[0]
+        src_shape = tuple(base.shape)
+        src_dtype = np.dtype(base.dtype)
+        label = "filtered chain base"
+    else:
+        src_shape = tuple(g.base.shape)
+        src_dtype = np.dtype(g.base.dtype)
+        label = "chain base" if g.funcs else "base (concrete)"
+    stages.append(Stage(0, label, src_shape, src_dtype, g.split,
+                        _spec(arr._mesh, src_shape, g.split)))
+    stages.append(Stage(
+        1, "%s() [pending stat]" % h.name, tuple(h.aval.shape),
+        np.dtype(h.aval.dtype), h.new_split,
+        _spec(arr._mesh, tuple(h.aval.shape), h.new_split),
+        note="terminal of a %d-member fused group, not yet dispatched"
+             % len(g.members)))
+    _note_fusable_group(g, 1, diags)
+    return Report(target + ", pending stat", stages, diags)
+
+
+def _note_fusable_group(g, idx, diags):
+    pend = [m for m in g.members if m.result is None]
+    if g.dispatched or not pend:
+        return
+    names = ", ".join(m.name for m in pend)
+    nbytes = _group_bytes(g)
+    diags.append(Diagnostic(
+        "BLT009", idx,
+        "fusable terminal set: %d pending stat terminal(s) [%s] resolve "
+        "from ONE %s pass reading ~%s (instead of %d passes / ~%s); "
+        "results are bit-identical to the standalone terminals"
+        % (len(pend), names, g.kind, _fmt_bytes(nbytes), len(pend),
+           _fmt_bytes(nbytes * len(pend))),
+        hint="read any member (or bolt.compute(...)) to dispatch the "
+             "group; terminals on other sources fall back per group"))
+
+
 def _check_predicate(pred, vshape, vdtype, idx, diags):
     """Abstractly trace a filter predicate over one value block and emit
     BLT001 (trace failure) / BLT007 (non-scalar per record) — the ONE
@@ -204,7 +282,17 @@ def _check_impl(obj):
             hint="re-materialise from the source array, or disable the "
                  "policy with engine.donation(None) before the "
                  "consuming terminal"))
+        # a donating PENDING terminal may still be joinable: further
+        # stat calls ride the same group (one donate for N stats)
+        _note_fusable(arr, -1, diags)
         rep = Report(target, stages, diags)
+        engine.record_diagnostics(len(diags))
+        return rep
+
+    if arr._spending is not None:
+        # a lazy stat result (bolt_tpu/tpu/multistat.py): report the
+        # group's single-pass plan without dispatching anything
+        rep = _check_spending(arr, target, stages, diags)
         engine.record_diagnostics(len(diags))
         return rep
 
@@ -212,6 +300,7 @@ def _check_impl(obj):
         # streaming plan (bolt_tpu.stream): walk the recorded stage
         # chain abstractly — same _stage_apply bodies the per-slab
         # program traces, eval_shape only, ZERO XLA compiles
+        _note_fusable(arr, 0, diags)
         rep = _check_stream(arr, target, stages, diags)
         engine.record_diagnostics(len(diags))
         return rep
@@ -252,6 +341,7 @@ def _check_impl(obj):
                             np.dtype(aval.dtype), arr._split,
                             _spec(mesh, shape, arr._split)))
         _idle_device_check(mesh, shape, arr._split, 0, diags, idle_seen)
+        _note_fusable(arr, 0, diags)
         rep = Report(target, stages, diags)
         engine.record_diagnostics(len(diags))
         return rep
@@ -362,6 +452,7 @@ def _check_impl(obj):
             hint="hold another reference to the source array or scope "
                  "engine.donation(None) to keep it readable"))
 
+    _note_fusable(arr, len(stages) - 1, diags)
     rep = Report(target, stages, diags, dynamic=dynamic)
     engine.record_diagnostics(len(diags))
     return rep

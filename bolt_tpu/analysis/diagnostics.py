@@ -27,6 +27,7 @@ CODES = {
     "BLT006": ("info", "terminal will donate the chain base"),
     "BLT007": ("error", "filter predicate is not a scalar per record"),
     "BLT008": ("info", "result shape is dynamic until a count sync"),
+    "BLT009": ("info", "fusable terminal set: one pass serves N stats"),
 }
 
 SEVERITIES = ("error", "warning", "info")

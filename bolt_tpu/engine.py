@@ -124,6 +124,12 @@ _SCHEMA = {
     "stream_inflight_high_water": 0,  # high-water slab programs
                                       # dispatched but not yet confirmed
                                       # complete (the async window)
+    # fused multi-terminal statistics (bolt.compute / a.stats(...) —
+    # bolt_tpu/tpu/multistat.py): groups of N pending stat terminals
+    # served by ONE tuple-output dispatch instead of N standalone passes
+    "fused_stat_groups": 0,       # multi-terminal fused dispatches
+    "fused_stat_terminals": 0,    # terminals served by those dispatches
+                                  # (terminals - groups = dispatches saved)
 }
 
 _COUNTERS = _metrics.registry().group("engine", _SCHEMA)
@@ -297,6 +303,14 @@ def donation(min_bytes):
         yield
     finally:
         st.pop()
+
+
+def record_fused_stats(n_terminals):
+    """Tally one fused multi-stat dispatch serving ``n_terminals``
+    pending terminals from a single pass (bolt_tpu/tpu/multistat.py);
+    the timeline carries it as the ``array.multi_stat`` span."""
+    _COUNTERS.update(fused_stat_groups=1,
+                     fused_stat_terminals=int(n_terminals))
 
 
 def donation_granted():

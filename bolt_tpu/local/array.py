@@ -126,15 +126,51 @@ class BoltArrayLocal(np.ndarray, BoltArray):
             out = out.reshape((1,) * len(key_shape) + value_shape)
         return BoltArrayLocal(out)
 
-    def stats(self, requested=("mean", "var", "std", "min", "max"), axis=None):
+    def stats(self, *requested, axis=None, accumulate=None, **kwargs):
         """Moment statistics over key axes, returned as a
         :class:`~bolt_tpu.statcounter.StatCounter` — the same contract the
         TPU backend serves via its shard_map Welford combine (reference:
         ``BoltArraySpark.stats`` via ``rdd.aggregate(StatCounter)``).
 
         ``axis=None`` means the leading axis, this backend's default key
-        axis."""
+        axis.
+
+        The FLUENT form ``stats("sum", "var", "min", ...)`` mirrors the
+        TPU backend's fused multi-stat (an ordered ``{name: array}``
+        dict, any of sum/mean/var/std/min/max/prod/all/any/ptp) — here
+        it is one NumPy pass per name, the semantic oracle the fused
+        programs are parity-tested against.  ``accumulate`` is accepted
+        for signature parity; the oracle always computes exactly."""
+        if requested and all(isinstance(r, str) for r in requested):
+            from collections import OrderedDict
+            from bolt_tpu.tpu.multistat import LAZY_NAMES
+            for n in requested:
+                if n not in LAZY_NAMES:
+                    raise ValueError(
+                        "unknown statistic %r; choose from %s"
+                        % (n, ", ".join(LAZY_NAMES)))
+            axes = (0,) if axis is None else tuple(sorted(tupleize(axis)))
+            x = np.asarray(self)
+            out = OrderedDict()
+            for n in requested:
+                out[n] = BoltArrayLocal(getattr(np, n)(x, axis=axes))
+            return out
         from bolt_tpu.statcounter import StatCounter
+        if requested:
+            # legacy positional form: stats(requested_tuple[, axis])
+            if len(requested) > 2:
+                raise TypeError("stats() takes at most 2 positional "
+                                "arguments (requested, axis)")
+            kwargs.setdefault("requested", requested[0])
+            if len(requested) == 2:
+                if axis is not None:
+                    raise TypeError("stats() got axis twice")
+                axis = requested[1]
+        requested = kwargs.pop("requested",
+                               ("mean", "var", "std", "min", "max"))
+        if kwargs:
+            raise TypeError("unexpected keyword arguments %r"
+                            % sorted(kwargs))
         axes = (0,) if axis is None else tuple(sorted(tupleize(axis)))
         inshape(self.shape, axes)
         x = np.asarray(self)

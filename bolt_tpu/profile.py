@@ -114,12 +114,13 @@ def instrument():
     import bolt_tpu.stream as _stream
     import bolt_tpu.tpu.array as _arr
     import bolt_tpu.tpu.chunk as _chunk
+    import bolt_tpu.tpu.multistat as _mstat
     import bolt_tpu.tpu.stack as _stack
     import bolt_tpu.tpu.stats as _stats
     # every module binds _cached_jit by name at import; snapshot and
     # restore EACH binding so nested/overlapping contexts unwind cleanly
-    saved = {m: m._cached_jit for m in (_arr, _chunk, _stack, _stats,
-                                        _stream)}
+    saved = {m: m._cached_jit for m in (_arr, _chunk, _mstat, _stack,
+                                        _stats, _stream)}
     orig = _arr._cached_jit
     stats = {}
 

@@ -608,16 +608,26 @@ def maybe_reduce(arr, func, axes, keepdims):
 # per-slab programs and on-device partial merges
 # ---------------------------------------------------------------------
 
-def _combine(terminal, rfunc, a, b):
+def _combine(terminal, rfunc, a, b, comps=None):
     """The ONE partial-merge arithmetic — traced by BOTH the standalone
     merge program (the pairwise tree above level 0) and the acc-fused
     slab program (level 0), so in-program and between-program merges
     cannot drift.  ``a`` is the EARLIER partial (fold order matters for
     ``reduce``); moments partials are ``(n, mu, M2)`` triples merged by
     the Chan et al. parallel recurrence (the statcounter ``mergeStats``
-    formula, vectorised over the value block)."""
+    formula, vectorised over the value block).  ``terminal="multi"``
+    (the fused multi-stat accumulator, bolt_tpu/tpu/multistat.py) merges
+    a TUPLE of components — each through this same function, so the
+    fused tuple merge and the standalone merges share one arithmetic."""
+    if terminal == "multi":
+        return tuple(_combine(_COMP_MERGE[c], rfunc, x, y)
+                     for c, x, y in zip(comps, a, b))
     if terminal == "sum":
         return jnp.add(a, b)
+    if terminal == "min":
+        return jnp.minimum(a, b)
+    if terminal == "max":
+        return jnp.maximum(a, b)
     if terminal == "reduce":
         return rfunc(a, b)
     n1, mu1, m21 = a
@@ -630,15 +640,79 @@ def _combine(terminal, rfunc, a, b):
     return n, mu, m2
 
 
-def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False):
+# multi-stat accumulator components -> the merge arithmetic each rides
+# ("moments" is the statcounter (n, mu, M2) triple shared by every
+# mean/var/std member of a fused group)
+_COMP_MERGE = {"sum": "sum", "min": "min", "max": "max",
+               "moments": "moments"}
+
+
+def _terminal_partial(terminal, flat, mask, mfull, vshape, n, rfunc):
+    """Per-slab partial for ONE terminal over the flattened records —
+    the exact expressions the standalone slab programs have always
+    traced, factored out so the fused multi-stat slab program composes
+    the SAME arithmetic per component (streamed-fused vs streamed-
+    standalone parity by construction)."""
+    if terminal == "sum":
+        # identity fold, exactly like _fused_filter_stat: dropped
+        # records (NaNs included) become inert zeros
+        v = flat if mfull is None else jnp.where(
+            mfull, flat, jnp.asarray(0, flat.dtype))
+        return jnp.sum(v, axis=0)
+    if terminal in ("min", "max"):
+        # exact order statistics; a filter predicate never reaches here
+        # (min/max multi-stat members are ineligible under a filter —
+        # zero survivors would need the materialised error contract)
+        op = jnp.min if terminal == "min" else jnp.max
+        return op(flat, axis=0)
+    if terminal == "reduce":
+        vfunc = jax.vmap(rfunc)
+        y = flat
+        while y.shape[0] > 1:
+            half = y.shape[0] // 2
+            combined = vfunc(y[:half], y[half:2 * half])
+            if combined.shape != y[:half].shape:
+                raise ValueError(
+                    "reduce produced shape %s, expected value "
+                    "shape %s" % (combined.shape[1:], tuple(vshape)))
+            rem = y[2 * half:]
+            y = jnp.concatenate([combined, rem], axis=0) \
+                if rem.shape[0] else combined
+        return y[0]
+    # moments: the statcounter triple (n, mu, M2) per value slot
+    out_dt = jax.eval_shape(
+        lambda t: jnp.mean(t, axis=0),
+        jax.ShapeDtypeStruct((1,) + tuple(vshape), flat.dtype)).dtype
+    if mfull is None:
+        cnt = jnp.asarray(n, out_dt)
+        xf = flat.astype(out_dt)
+    else:
+        cnt = jnp.sum(mask.astype(out_dt))
+        xf = jnp.where(mfull, flat,
+                       jnp.asarray(0, flat.dtype)).astype(out_dt)
+    safe = jnp.where(cnt > 0, cnt, jnp.asarray(1, out_dt))
+    mu = jnp.sum(xf, axis=0) / safe
+    dev = xf - mu
+    if mfull is not None:
+        dev = jnp.where(mfull, dev, jnp.asarray(0, out_dt))
+    m2 = jnp.sum(dev * dev, axis=0)
+    return cnt, mu, m2
+
+
+def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
+                  comps=None):
     """The ONE compiled program each slab runs: device-side stages +
     (masked) terminal partial, with the slab buffer DONATED so the ring
     recycles its memory.  ``fused=True`` is the level-0 fold fusion: the
     program additionally takes the PREVIOUS slab's partial and merges it
     in the same dispatch (``prog(buf, acc)``), halving fold dispatches —
-    the acc is donated too, it is consumed.  Engine-cached per (stages,
-    terminal, slab geometry, fused): uniform slabs compile exactly once
-    per variant."""
+    the acc is donated too, it is consumed.  ``terminal="multi"`` emits
+    a TUPLE of component partials (``comps`` ⊆ sum/moments/min/max) from
+    the SAME single read of the slab — the streamed half of the fused
+    multi-stat layer (bolt_tpu/tpu/multistat.py); each component traces
+    the exact standalone expression via :func:`_terminal_partial`.
+    Engine-cached per (stages, terminal, slab geometry, fused, comps):
+    uniform slabs compile exactly once per variant."""
     stages = source.stages
     pred = None
     if stages and stages[-1][0] == "filter":
@@ -648,7 +722,7 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False):
     mesh = source.mesh
     key = ("stream-slab-acc" if fused else "stream-slab", terminal,
            stages, pred, slab_shape, str(source.dtype), split, ddof,
-           rfunc, mesh)
+           rfunc, comps, mesh)
 
     def build():
         def partial(data):
@@ -659,50 +733,18 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False):
             vshape = x.shape[split:]
             n = prod(x.shape[:split])
             flat = x.reshape((n,) + vshape)
-            mfull = None
+            mask = mfull = None
             if pred is not None:
                 mask = _pred_mask(pred, flat)
                 mfull = mask.reshape((n,) + (1,) * len(vshape))
-            if terminal == "sum":
-                # identity fold, exactly like _fused_filter_stat: dropped
-                # records (NaNs included) become inert zeros
-                v = flat if mfull is None else jnp.where(
-                    mfull, flat, jnp.asarray(0, flat.dtype))
-                return jnp.sum(v, axis=0)
-            if terminal == "reduce":
-                vfunc = jax.vmap(rfunc)
-                y = flat
-                while y.shape[0] > 1:
-                    half = y.shape[0] // 2
-                    combined = vfunc(y[:half], y[half:2 * half])
-                    if combined.shape != y[:half].shape:
-                        raise ValueError(
-                            "reduce produced shape %s, expected value "
-                            "shape %s" % (combined.shape[1:],
-                                          tuple(vshape)))
-                    rem = y[2 * half:]
-                    y = jnp.concatenate([combined, rem], axis=0) \
-                        if rem.shape[0] else combined
-                return y[0]
-            # moments: the statcounter triple (n, mu, M2) per value slot
-            out_dt = jax.eval_shape(
-                lambda t: jnp.mean(t, axis=0),
-                jax.ShapeDtypeStruct((1,) + tuple(vshape),
-                                     flat.dtype)).dtype
-            if mfull is None:
-                cnt = jnp.asarray(n, out_dt)
-                xf = flat.astype(out_dt)
-            else:
-                cnt = jnp.sum(mask.astype(out_dt))
-                xf = jnp.where(mfull, flat,
-                               jnp.asarray(0, flat.dtype)).astype(out_dt)
-            safe = jnp.where(cnt > 0, cnt, jnp.asarray(1, out_dt))
-            mu = jnp.sum(xf, axis=0) / safe
-            dev = xf - mu
-            if mfull is not None:
-                dev = jnp.where(mfull, dev, jnp.asarray(0, out_dt))
-            m2 = jnp.sum(dev * dev, axis=0)
-            return cnt, mu, m2
+            if terminal == "multi":
+                return tuple(
+                    _terminal_partial(c, flat, mask, mfull, vshape, n,
+                                      None)
+                    for c in comps)
+            return _terminal_partial(
+                terminal if terminal in ("sum", "reduce") else "moments",
+                flat, mask, mfull, vshape, n, rfunc)
 
         if not fused:
             return jax.jit(partial, donate_argnums=(0,))
@@ -710,7 +752,8 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False):
         def run(data, acc):
             # level-0 fold fused in: acc (the EVEN slab's partial) merges
             # with this (ODD) slab's partial inside one dispatch
-            return _combine(terminal, rfunc, acc, partial(data))
+            return _combine(terminal, rfunc, acc, partial(data),
+                            comps=comps)
         return jax.jit(run, donate_argnums=(0, 1))
 
     return _cached_jit(key, build)
@@ -735,6 +778,18 @@ def _merge_program(terminal, shape, dtype, rfunc, mesh):
             return _combine("moments", None, (n1, mu1, m21),
                             (n2, mu2, m22))
         return jax.jit(merge)
+    return _cached_jit(key, build)
+
+
+def _merge_multi_program(comps, sig, mesh):
+    """Pairwise merge of two fused multi-stat partial TUPLES (pytree
+    in, pytree out — one dispatch merges every component; ``sig`` is
+    the flattened (shape, dtype) leaf signature for the cache key)."""
+    key = ("stream-merge-multi", comps, sig, mesh)
+
+    def build():
+        return jax.jit(lambda a, b: _combine("multi", None, a, b,
+                                             comps=comps))
     return _cached_jit(key, build)
 
 
@@ -913,14 +968,45 @@ def _acquire(sem, stop):
     return False
 
 
-def execute(arr, terminal, ddof=None, rfunc=None):
+def _multi_comps(specs):
+    """Canonical component tuple for a fused multi-stat spec list —
+    ONE 'moments' triple serves every mean/var/std member, 'min'/'max'
+    serve their members AND both halves of a ``ptp``."""
+    names = [name for name, _ in specs]
+    comps = []
+    if "sum" in names:
+        comps.append("sum")
+    if any(n in ("mean", "var", "std") for n in names):
+        comps.append("moments")
+    if "min" in names or "ptp" in names:
+        comps.append("min")
+    if "max" in names or "ptp" in names:
+        comps.append("max")
+    return tuple(comps)
+
+
+def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
+            source=None):
     """Run a streamed reduction terminal over ``arr``'s source: the
     parallel-ingest, async-dispatch pipeline described in the module
-    docstring.  Returns a value-shaped ``BoltArrayTPU`` (``split=0``)."""
+    docstring.  Returns a value-shaped ``BoltArrayTPU`` (``split=0``).
+
+    ``terminal="multi"`` streams a fused multi-stat group
+    (bolt_tpu/tpu/multistat.py): ``specs`` is the ordered ``(name,
+    ddof)`` member list, the per-slab program emits one component tuple
+    per slab from a single read, and the return value is a LIST of
+    value-shaped arrays, one per member — each finalised from the
+    shared folded components exactly as its standalone streamed
+    terminal would be.  ``source`` overrides ``arr._stream`` for
+    callers resolving already-detached pending handles (``arr=None``
+    skips the strict gate — the handle was gated at creation)."""
     global _LAST_THREAD, _LAST_POOL
     from bolt_tpu.tpu.array import BoltArrayTPU
-    source = arr._stream
-    _engine.strict_guard(arr, "stream.%s()" % terminal)
+    comps = _multi_comps(specs) if terminal == "multi" else None
+    if source is None:
+        source = arr._stream
+    if arr is not None:
+        _engine.strict_guard(arr, "stream.%s()" % terminal)
     mesh = source.mesh
     split = source.split
     depth = prefetch_depth()
@@ -1081,6 +1167,15 @@ def execute(arr, terminal, ddof=None, rfunc=None):
                 shape, dtype = part.shape, part.dtype
                 fold = _PairFold(lambda: _merge_program(
                     terminal, shape, dtype, rfunc, mesh))
+            elif terminal == "multi":
+                sig = tuple(
+                    (tuple(leaf.shape), str(leaf.dtype))
+                    for leaf in jax.tree_util.tree_leaves(part))
+
+                def factory():
+                    mp = _merge_multi_program(comps, sig, mesh)
+                    return lambda a, b: tuple(mp(a, b))
+                fold = _PairFold(factory)
             else:
                 mshape, mdtype = part[1].shape, part[1].dtype
 
@@ -1114,13 +1209,14 @@ def execute(arr, terminal, ddof=None, rfunc=None):
                             message="Some donated buffers were not usable")
                         if pend is None:
                             prog = _slab_program(source, terminal,
-                                                 buf.shape, ddof, rfunc)
+                                                 buf.shape, ddof, rfunc,
+                                                 comps=comps)
                             pend = prog(buf)
                         else:
                             # level-0 fold fused into the slab dispatch
                             prog = _slab_program(source, terminal,
                                                  buf.shape, ddof, rfunc,
-                                                 fused=True)
+                                                 fused=True, comps=comps)
                             pairp = prog(buf, pend)
                             pend = None
                             _fold_push(pairp)
@@ -1177,12 +1273,14 @@ def execute(arr, terminal, ddof=None, rfunc=None):
         try:
             if terminal in ("sum", "reduce"):
                 out = fold.result()
+            elif terminal == "multi":
+                out = _finalise_multi(fold.result(), comps, specs, mesh)
             else:
                 n, mu, m2 = fold.result()
                 out = _finalise_program(terminal, mu.shape, mu.dtype,
                                         ddof, mesh)(n, mu, m2)
             # the ONE synchronisation point of the whole run
-            out.block_until_ready()
+            jax.block_until_ready(out)
         finally:
             _obs.end(fsp)
         compute += _clock() - t0
@@ -1197,9 +1295,42 @@ def execute(arr, terminal, ddof=None, rfunc=None):
                        overlap_s=round(overlap, 6),
                        concurrent_uploaders=max(act["hw"], 1),
                        inflight_high_water=max(inflight_hw, 1))
+        if terminal == "multi":
+            return list(out)              # one jax array per member spec
         return BoltArrayTPU(out, 0, mesh)
     finally:
         _obs.end(run_sp)
+
+
+def _finalise_multi(folded, comps, specs, mesh):
+    """Per-member outputs from the folded component tuple: each member
+    finalises from the SHARED components exactly as its standalone
+    streamed terminal would (``_finalise_program`` for the moment
+    family, identity for sum/min/max, the fused max−min subtraction for
+    ``ptp``)."""
+    by = dict(zip(comps, folded))
+
+    def _sub(a, b):
+        # the SAME cached max−min program the in-memory fused groups
+        # use (one "multi-stat-sub" key per geometry, both paths)
+        from bolt_tpu.tpu.multistat import _sub_program
+        return _sub_program(a.shape, a.dtype, mesh)(a, b)
+
+    outs = []
+    for name, ddof_m in specs:
+        if name == "sum":
+            outs.append(by["sum"])
+        elif name == "min":
+            outs.append(by["min"])
+        elif name == "max":
+            outs.append(by["max"])
+        elif name == "ptp":
+            outs.append(_sub(by["max"], by["min"]))
+        else:
+            n, mu, m2 = by["moments"]
+            outs.append(_finalise_program(name, mu.shape, mu.dtype,
+                                          ddof_m, mesh)(n, mu, m2))
+    return outs
 
 
 # ---------------------------------------------------------------------

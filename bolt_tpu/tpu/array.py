@@ -468,6 +468,60 @@ def _pred_mask(pred, flat):
         lambda v: jnp.asarray(pred(v), dtype=bool).reshape(()))(flat)
 
 
+def _masked_stat_expr(name, flat, mask, mfull, axes, keepdims, ddof,
+                      vshape, vdtype):
+    """ONE masked reduction over the flattened filtered records — the
+    arithmetic of the fused ``filter(...).sum()``-family terminals,
+    factored out so the standalone filter-stat program and the fused
+    multi-terminal program (bolt_tpu/tpu/multistat.py) trace the SAME
+    expressions and cannot drift.  ``mean/var/std`` divide by the
+    masked COUNT computed in the same pass (var as the one-pass moment
+    form ``(Σx² − (Σx)²/n)/(n−ddof)``); the rest fold dropped records
+    onto their identity."""
+    vdtype = np.dtype(vdtype)
+    op = {"sum": jnp.sum, "prod": jnp.prod, "any": jnp.any,
+          "all": jnp.all, "max": jnp.max, "min": jnp.min}.get(name)
+    ref = {"mean": jnp.mean, "var": jnp.var, "std": jnp.std}.get(
+        name, op)
+    # output dtype from jnp's own promotion rule on a 1-record probe,
+    # so fused and eager results always agree on dtype
+    out_dt = jax.eval_shape(
+        lambda x: ref(x, axis=axes), jax.ShapeDtypeStruct(
+            (1,) + tuple(vshape), vdtype)).dtype
+    if name in ("sum", "prod", "any", "all", "max", "min"):
+        if name in ("sum", "prod", "any", "all"):
+            ident = {"sum": 0, "prod": 1, "any": False,
+                     "all": True}[name]
+        elif np.issubdtype(vdtype, np.floating) or \
+                np.issubdtype(vdtype, np.complexfloating):
+            ident = -np.inf if name == "max" else np.inf
+        elif vdtype == np.bool_:
+            ident = name == "min"
+        else:
+            info = np.iinfo(vdtype)
+            ident = info.min if name == "max" else info.max
+        v = jnp.where(mfull, flat, jnp.asarray(ident, flat.dtype))
+        out = op(v, axis=axes, keepdims=keepdims)
+        if out.dtype != out_dt:
+            out = out.astype(out_dt)
+        return out
+    # element count each output slot divides by beyond the mask: the
+    # reduced VALUE axes are dense (the mask only thins records)
+    prodv = prod([vshape[a - 1] for a in axes if a > 0])
+    cnt = jnp.sum(mask, dtype=jnp.int32)
+    den = (cnt * prodv).astype(out_dt)
+    xf = jnp.where(mfull, flat, jnp.zeros((), flat.dtype)).astype(out_dt)
+    s1 = jnp.sum(xf, axis=axes, keepdims=keepdims)
+    if name == "mean":
+        return s1 / den
+    dd = 0.0 if ddof is None else ddof
+    s2 = jnp.sum(xf * xf, axis=axes, keepdims=keepdims)
+    out = (s2 - s1 * s1 / den) / (den - dd)
+    if name == "std":
+        out = jnp.sqrt(out)
+    return out
+
+
 class BoltArrayTPU(BoltArray):
     """Distributed n-d array: key axes sharded over a TPU mesh, value axes
     local to each device."""
@@ -496,6 +550,15 @@ class BoltArrayTPU(BoltArray):
         # data exists yet; reduction terminals run the double-buffered
         # streaming executor, everything else materialises via ._data
         self._stream = None
+        # lazy stat terminal (bolt_tpu/tpu/multistat.py): this array IS
+        # the not-yet-dispatched result of a sum()/var()/... terminal —
+        # a PendingStat handle into a shared single-pass group; the
+        # first read resolves the group (fused with any siblings)
+        self._spending = None
+        # the live (undispatched) stat group reading THIS array's
+        # terminals — later sum()/var()/... calls join it, so N stats
+        # on one source fuse into one pass (and one donate)
+        self._stat_group = None
         self._donated = False
         self._aval = None if data is None else jax.ShapeDtypeStruct(
             data.shape, data.dtype)
@@ -692,11 +755,27 @@ class BoltArrayTPU(BoltArray):
                                           self._concrete.dtype)
         self._pending = None
 
+    def _resolve_spending(self):
+        """Adopt the result of this array's lazy stat terminal,
+        dispatching its group's single-pass program on first need (any
+        pending siblings of the group resolve in the same dispatch —
+        the read-side half of ``bolt.compute``)."""
+        h = self._spending
+        if h is None:
+            return
+        if h.result is None:
+            h.group.resolve()
+        self._concrete = h.result
+        self._aval = jax.ShapeDtypeStruct(h.result.shape, h.result.dtype)
+        self._spending = None
+
     @property
     def _data(self):
         """The concrete sharded ``jax.Array``; materialises a deferred
         chain on first access (one fused compiled program)."""
         self._guard_donated()
+        if self._spending is not None:
+            self._resolve_spending()
         if self._stream is not None:
             # materialise the lazy out-of-core source through the
             # STANDARD machinery (stream.materialize replays every
@@ -1085,6 +1164,17 @@ class BoltArrayTPU(BoltArray):
 
     def _stat(self, axis, name, keepdims=False, ddof=None):
         _engine.strict_guard(self, "%s()" % name)
+        # lazy door (bolt_tpu/tpu/multistat.py): the stat family defers
+        # as a PendingStat handle — validation/strict/donation stay
+        # eager here, only the dispatch moves to the first read, and
+        # handles sharing this source fuse into ONE single-pass program
+        # (bolt.compute / a.stats(...)).  NotImplemented falls through
+        # to the eager paths (consumed sources, zero-size extrema,
+        # geometries the fused machinery does not serve).
+        from bolt_tpu.tpu import multistat as _ms
+        out = _ms.defer_stat(self, axis, name, keepdims, ddof)
+        if out is not NotImplemented:
+            return out
         if self._stream is not None:
             # lazy out-of-core source: run the reduction as a streamed
             # double-buffered pipeline when the geometry allows (all key
@@ -1184,58 +1274,20 @@ class BoltArrayTPU(BoltArray):
         mesh = self._mesh
         new_split = 1 if keepdims else 0
         needs_count = name in ("max", "min")
-        # element count each output slot divides by beyond the mask: the
-        # reduced VALUE axes are dense (the mask only thins records)
-        prodv = prod([vshape[a - 1] for a in axes if a > 0])
 
         def build():
-            op = {"sum": jnp.sum, "prod": jnp.prod, "any": jnp.any,
-                  "all": jnp.all, "max": jnp.max, "min": jnp.min}.get(name)
-            ref = {"mean": jnp.mean, "var": jnp.var, "std": jnp.std}.get(
-                name, op)
-            # output dtype from jnp's own promotion rule on a 1-record
-            # probe, so fused and eager results always agree on dtype
-            out_dt = jax.eval_shape(
-                lambda x: ref(x, axis=axes), jax.ShapeDtypeStruct(
-                    (1,) + tuple(vshape), vdtype)).dtype
-            if name in ("sum", "prod", "any", "all"):
-                ident = {"sum": 0, "prod": 1, "any": False,
-                         "all": True}[name]
-            elif name in ("max", "min"):
-                if np.issubdtype(vdtype, np.floating) or \
-                        np.issubdtype(vdtype, np.complexfloating):
-                    ident = -np.inf if name == "max" else np.inf
-                elif vdtype == np.bool_:
-                    ident = name == "min"
-                else:
-                    info = np.iinfo(vdtype)
-                    ident = info.min if name == "max" else info.max
-
             def stat(data):
                 mapped = _chain_apply(funcs, psplit, data)
                 flat = mapped.reshape((n,) + tuple(vshape))
                 mask = _pred_mask(pred, flat)
                 mfull = mask.reshape((n,) + (1,) * len(vshape))
                 cnt = jnp.sum(mask, dtype=jnp.int32)
-                if name in ("sum", "prod", "any", "all", "max", "min"):
-                    v = jnp.where(mfull, flat, jnp.asarray(ident,
-                                                           flat.dtype))
-                    out = op(v, axis=axes, keepdims=keepdims)
-                    if out.dtype != out_dt:
-                        out = out.astype(out_dt)
-                else:
-                    den = (cnt * prodv).astype(out_dt)
-                    xf = jnp.where(mfull, flat,
-                                   jnp.zeros((), flat.dtype)).astype(out_dt)
-                    s1 = jnp.sum(xf, axis=axes, keepdims=keepdims)
-                    if name == "mean":
-                        out = s1 / den
-                    else:
-                        dd = 0.0 if ddof is None else ddof
-                        s2 = jnp.sum(xf * xf, axis=axes, keepdims=keepdims)
-                        out = (s2 - s1 * s1 / den) / (den - dd)
-                        if name == "std":
-                            out = jnp.sqrt(out)
+                # the per-terminal masked reduction lives in ONE module
+                # function, shared with the fused multi-terminal
+                # program (bolt_tpu/tpu/multistat.py) — single and
+                # fused filter-stats trace identical arithmetic
+                out = _masked_stat_expr(name, flat, mask, mfull, axes,
+                                        keepdims, ddof, vshape, vdtype)
                 out = _constrain(out, mesh, new_split)
                 return (out, cnt) if needs_count else out
             return jax.jit(stat, donate_argnums=(0,) if donate else ())
@@ -1439,12 +1491,40 @@ class BoltArrayTPU(BoltArray):
                           split, axis, mesh), build)
         return self._wrap(fn(_check_live(base)), new_split)
 
-    def stats(self, requested=("mean", "var", "std", "min", "max"), axis=None):
-        """Single-pass streaming statistics via an explicit shard_map Welford
-        combine (reference: ``rdd.aggregate(StatCounter)``); see
-        ``bolt_tpu/tpu/stats.py :: welford``."""
+    def stats(self, *requested, axis=None, accumulate=None, **kwargs):
+        """Statistics in one pass, two forms:
+
+        * ``stats()`` / ``stats(("mean", "var"))`` /
+          ``stats(requested=..., axis=...)`` — the reference contract: a
+          :class:`~bolt_tpu.statcounter.StatCounter` of Welford moments
+          via the explicit shard_map combine
+          (``bolt_tpu/tpu/stats.py :: welford``).
+        * ``stats("sum", "var", "min", ...)`` — the fluent FUSED
+          multi-stat (bolt_tpu/tpu/multistat.py): every requested
+          terminal (any of sum/mean/var/std/min/max/prod/all/any/ptp)
+          from ONE single-pass program over this array — deferred
+          chains applied once, streamed sources ingested once — each
+          result bit-identical to its standalone terminal; returns an
+          ordered ``{name: value-shaped array}`` dict.  ``accumulate``
+          opts the additive terminals into the reduced-precision path
+          (see :func:`bolt_tpu.tpu.multistat.compute`).
+        """
+        if requested and all(isinstance(r, str) for r in requested):
+            from bolt_tpu.tpu.multistat import fluent_stats
+            return fluent_stats(self, requested, axis=axis,
+                                accumulate=accumulate)
         from bolt_tpu.tpu.stats import welford
-        return welford(self, requested=requested, axis=axis)
+        if requested:
+            # legacy positional form: stats(requested_tuple[, axis])
+            if len(requested) > 2:
+                raise TypeError("stats() takes at most 2 positional "
+                                "arguments (requested, axis)")
+            kwargs.setdefault("requested", requested[0])
+            if len(requested) == 2:
+                if axis is not None:
+                    raise TypeError("stats() got axis twice")
+                axis = requested[1]
+        return welford(self, axis=axis, **kwargs)
 
     def quantile(self, q, axis=None, keepdims=False, method="linear"):
         """The ``q``-th quantile over ``axis`` (default: all key axes) —
@@ -3113,6 +3193,10 @@ class BoltArrayTPU(BoltArray):
         # re-stream on demand, and either wrapper materialising adopts
         # its own concrete state without touching the other
         b._stream = self._stream
+        # a pending stat handle is shared too: either wrapper's first
+        # read resolves the group once and both adopt the same result
+        b._spending = self._spending
+        b._stat_group = self._stat_group
         b._donated = self._donated
         b._aval = self._aval
         return b
@@ -3254,6 +3338,10 @@ class BoltArrayTPU(BoltArray):
         s += "dtype: %s\n" % str(self.dtype)
         if self.deferred:
             s += "deferred: %d-op map chain\n" % len(self._chain[1])
+        elif self._spending is not None:
+            # don't dispatch the fused group just to print
+            s += "pending: lazy %s() terminal (fused group not yet " \
+                 "dispatched)\n" % self._spending.name
         elif self._fpending is not None:
             s += "pending: deferred filter (predicate not yet dispatched)\n"
         elif self._pending is not None:

@@ -1,0 +1,624 @@
+"""One-pass multi-terminal statistics: the fused ``bolt.compute`` layer.
+
+The single-terminal reductions are at the HBM roofline — a ``map→sum``
+pass reads every byte once, and no further single-chip win exists for
+ONE statistic.  What Bolt's design has always promised (PAPER.md: every
+StatCounter moment from one pass over the values) is doing MORE per byte
+read: this module makes ``a.sum()``-family terminals *lazy*
+:class:`PendingStat` handles and groups handles that share a source —
+the same deferred ``_chain``, the same deferred ``_fpending`` filter, or
+the same out-of-core stream — into a :class:`_StatGroup` that dispatches
+ONE tuple-output program::
+
+    s, v, lo, hi = bolt.compute(a.sum(), a.var(), a.min(), a.max())
+    # map/filter stages applied once, four partials from ONE HBM pass
+
+Laziness is read-transparent: everything observable at call time stays
+at call time (axis validation, the ``analysis.strict`` gate, the
+donation decision — a sole-owned chain base is consumed by its FIRST
+pending terminal and later siblings join the same group, so N fused
+stats cost one donate), and only the engine dispatch moves to the first
+read.  A handle read before any sibling exists resolves through the
+EXACT standalone program — same engine key, same expressions — so a
+lone ``a.sum()`` is byte-for-byte the pre-fusion terminal; a fused
+group's outputs are bit-identical to those standalone terminals because
+the tuple program traces the same per-terminal expressions over one
+shared read (XLA's sibling multi-output fusion serves them from a
+single traversal).
+
+Grouping rule: same ``_chain``/``_fpending``/stream source ⇒ same
+program; anything else falls back per group ("mixed chains fall back
+per group").  ``ptp`` routes through the fused min/max pair — its slots
+dedup against sibling ``min``/``max`` members, so
+``compute(a.ptp(), a.min(), a.max())`` still emits exactly two extrema
+from one pass (and ``a.ptp()`` alone shares the pair program's key
+instead of owning a private one).
+
+Reduced-precision accumulation (``compute(..., accumulate="bf16")`` or
+the :func:`bolt_tpu._precision.accumulate` scope) is the opt-in fast
+path for the additive terminals of an in-memory fused group: values
+cast to bf16, accumulated in f32 (the accumulate-in-f32 contract; "f32"
+casts values to f32, which for f32 pipelines is exactly the default
+arithmetic).  The default (``None``) stays bit-exact; order statistics
+(min/max/any/all, the pair behind ptp) are always exact.
+
+Streamed groups fold a tuple accumulator through the PR 5 pipeline
+(``stream.execute(terminal="multi")``): one ingest pass feeds every
+member, the shared ``(n, mu, M2)`` moments triple serves all of
+mean/var/std, and Chan denominators stay exact on power-of-two slab
+counts — streamed multi-stat matches materialised bit-exactly there.
+"""
+
+from collections import OrderedDict
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bolt_tpu import engine as _engine
+from bolt_tpu import _precision
+from bolt_tpu import stream as _streamlib
+from bolt_tpu.obs import trace as _obs
+from bolt_tpu.utils import inshape, prod, tupleize
+
+
+def _cached_jit(key, builder):
+    """Engine-routed executable dispatch (same contract as the op
+    modules')."""
+    return _engine.get(key, builder)
+
+
+# terminals that defer as PendingStat handles (everything _stat serves)
+LAZY_NAMES = ("sum", "mean", "var", "std", "min", "max", "prod", "all",
+              "any", "ptp")
+
+# deferred-filter groups: min/max need the survivor-count sync (the
+# zero-size error contract) and stay eager; ptp resolves the filter
+_FPENDING_LAZY = ("sum", "prod", "any", "all", "mean", "var", "std")
+
+# streamed groups: the accumulator components the slab programs emit
+# (prod/all/any have no bit-exact streamed fold and materialise)
+_STREAM_LAZY = ("sum", "mean", "var", "std", "min", "max", "ptp")
+
+# accumulate= applies to the additive reductions only; order statistics
+# are exact regardless
+_ADDITIVE = ("sum", "prod", "mean", "var", "std")
+
+_OPS = {"mean": jnp.mean, "var": jnp.var, "std": jnp.std,
+        "sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+        "prod": jnp.prod, "all": jnp.all, "any": jnp.any,
+        "ptp": jnp.ptp}
+
+
+class PendingStat:
+    """One lazy stat terminal: the member record of a
+    :class:`_StatGroup`.  Holds the normalised spec, the abstractly
+    derived output aval, and (after the group dispatches) the concrete
+    result the owning array adopts on first read."""
+
+    __slots__ = ("group", "name", "axes", "keepdims", "ddof", "aval",
+                 "new_split", "result")
+
+    def __init__(self, group, name, axes, keepdims, ddof, aval,
+                 new_split):
+        self.group = group
+        self.name = name
+        self.axes = axes
+        self.keepdims = bool(keepdims)
+        self.ddof = ddof
+        self.aval = aval
+        self.new_split = int(new_split)
+        self.result = None
+
+    def __repr__(self):
+        return "PendingStat(%s, axes=%s%s)" % (
+            self.name, self.axes,
+            ", resolved" if self.result is not None else "")
+
+
+def _slot(member):
+    """Program-output slot(s) one member needs — ``ptp`` expands to the
+    min/max pair so its slots dedup against sibling extrema members."""
+    if member.name == "ptp":
+        return (("max", member.axes, member.keepdims, None),
+                ("min", member.axes, member.keepdims, None))
+    return ((member.name, member.axes, member.keepdims, member.ddof),)
+
+
+class _StatGroup:
+    """A set of pending stat terminals sharing ONE single-pass source.
+
+    ``kind``:
+
+    * ``"chain"``   — a deferred map chain (or a concrete base): the
+      fused program applies the chain once and emits one reduction per
+      slot.  ``donate`` was decided (with the standalone terminals'
+      exact refcount test) when the FIRST handle was created; the
+      consumed source keeps a pointer here so later siblings join the
+      group — one donate for N stats.
+    * ``"fpending"`` — a deferred filter: mapped chain + predicate mask
+      traced once, every member folds the same mask.
+    * ``"stream"``  — a lazy out-of-core source: one ingest pass through
+      ``stream.execute(terminal="multi")`` feeds a tuple accumulator.
+    """
+
+    __slots__ = ("kind", "mesh", "split", "base", "funcs", "fpending",
+                 "source", "donate", "in_aval", "members", "dispatched",
+                 "lock")
+
+    def __init__(self, kind, mesh, split, base=None, funcs=(),
+                 fpending=None, source=None, donate=False, in_aval=None):
+        self.kind = kind
+        self.mesh = mesh
+        self.split = split
+        self.base = base
+        self.funcs = funcs
+        self.fpending = fpending
+        self.source = source
+        self.donate = donate
+        self.in_aval = in_aval
+        self.members = []
+        self.dispatched = False
+        self.lock = threading.Lock()
+
+    # -- joining -------------------------------------------------------
+
+    def try_join(self, axis, name, keepdims, ddof):
+        """Validate ``(axis, name, ...)`` against this group's kind and
+        geometry; returns a new member handle, or NotImplemented when
+        the spec cannot ride this group's fused program (the caller
+        falls back to the eager path)."""
+        if self.kind == "stream":
+            h = _stream_member(self, name, axis, keepdims, ddof)
+        elif self.kind == "fpending":
+            h = _fpending_member(self, name, axis, keepdims, ddof)
+        else:
+            h = _chain_member(self, name, axis, keepdims, ddof)
+        if h is not NotImplemented:
+            with self.lock:
+                if self.dispatched:
+                    # a concurrent reader resolved the group between
+                    # the caller's check and this append: the new
+                    # member would never be filled — decline, the
+                    # caller starts a fresh group / eager path
+                    return NotImplemented
+                self.members.append(h)
+        return h
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve(self, accumulate=None):
+        """Dispatch the group's program(s), filling every member's
+        ``result``.  Idempotent and thread-safe; ``accumulate`` is the
+        per-call reduced-precision override (``bolt.compute``'s
+        kwarg)."""
+        with self.lock:
+            if self.dispatched:
+                return
+            mode = _precision.resolve_accumulate(accumulate)
+            if mode is not None and self.kind != "chain":
+                if accumulate is not None:
+                    raise ValueError(
+                        "accumulate=%r applies to in-memory fused "
+                        "reductions only; this group streams/filters "
+                        "(%s) and runs exact" % (accumulate, self.kind))
+                mode = None             # ambient scope: exact fallback
+            if self.kind == "chain":
+                self._resolve_chain(mode)
+            elif self.kind == "fpending":
+                self._resolve_fpending()
+            else:
+                self._resolve_stream()
+            self.dispatched = True
+
+    def _resolve_chain(self, mode):
+        from bolt_tpu.tpu.array import _check_live, _chain_apply, \
+            _constrain
+        members = self.members
+        base, funcs, split, mesh = (self.base, self.funcs, self.split,
+                                    self.mesh)
+        donate = self.donate
+        if len(members) == 1 and members[0].name != "ptp" and mode is None:
+            # standalone resolution: the EXACT pre-fusion terminal —
+            # same engine key, same traced expressions
+            m = members[0]
+
+            def build():
+                op = _OPS[m.name]
+                kwargs = {} if m.ddof is None else {"ddof": m.ddof}
+
+                def stat(data):
+                    mapped = _chain_apply(funcs, split, data)
+                    out = op(mapped, axis=m.axes, keepdims=m.keepdims,
+                             **kwargs)
+                    return _constrain(out, mesh, m.new_split)
+                return jax.jit(stat,
+                               donate_argnums=(0,) if donate else ())
+
+            fn = _cached_jit(("stat", m.name, funcs, base.shape,
+                              str(base.dtype), split, m.axes, m.keepdims,
+                              m.ddof, donate, mesh), build)
+            with _obs.span("array.stat", op=m.name, funcs=len(funcs),
+                           donate=donate):
+                m.result = fn(_check_live(base))
+            return
+
+        # the fused multi-terminal program: one read, one slot per
+        # distinct (name, axes, keepdims, ddof) — sorted for an
+        # order-insensitive key, deduped so compute(ptp, min, max)
+        # still emits exactly two extrema
+        slots = sorted({s for m in members for s in _slot(m)}, key=repr)
+        slots = tuple(slots)
+        nsplit = {s: _new_split(split, s[1], s[2]) for s in slots}
+
+        def build():
+            def stat(data):
+                mapped = _chain_apply(funcs, split, data)
+                outs = []
+                for (name, axes, keepdims, ddof) in slots:
+                    outs.append(_constrain(
+                        _stat_expr(mapped, name, axes, keepdims, ddof,
+                                   mode),
+                        mesh, nsplit[(name, axes, keepdims, ddof)]))
+                return tuple(outs)
+            return jax.jit(stat, donate_argnums=(0,) if donate else ())
+
+        fn = _cached_jit(("multi-stat", slots, funcs, base.shape,
+                          str(base.dtype), split, donate, mode, mesh),
+                         build)
+        with _obs.span("array.multi_stat", terminals=len(members),
+                       slots=len(slots), funcs=len(funcs),
+                       donate=donate, accumulate=mode or "exact"):
+            outs = fn(_check_live(base))
+        if len(members) > 1:
+            _engine.record_fused_stats(len(members))
+        index = {s: i for i, s in enumerate(slots)}
+        for m in members:
+            if m.name == "ptp":
+                mx = outs[index[_slot(m)[0]]]
+                mn = outs[index[_slot(m)[1]]]
+                m.result = _sub_program(mx.shape, mx.dtype, mesh)(mx, mn)
+            else:
+                m.result = outs[index[_slot(m)[0]]]
+
+    def _resolve_fpending(self):
+        from bolt_tpu.tpu.array import _check_live, _chain_apply, \
+            _constrain, _masked_stat_expr, _pred_mask
+        members = self.members
+        base, funcs, pred, psplit, vshape, n, vdtype = self.fpending
+        mesh = self.mesh
+        donate = self.donate
+        if len(members) == 1:
+            # standalone resolution: the exact filter-stat terminal of
+            # the eager path (same key, same expressions; never
+            # needs_count — min/max handles are not lazy here)
+            m = members[0]
+
+            def build():
+                def stat(data):
+                    mapped = _chain_apply(funcs, psplit, data)
+                    flat = mapped.reshape((n,) + tuple(vshape))
+                    mask = _pred_mask(pred, flat)
+                    mfull = mask.reshape((n,) + (1,) * len(vshape))
+                    out = _masked_stat_expr(
+                        m.name, flat, mask, mfull, m.axes, m.keepdims,
+                        m.ddof, vshape, vdtype)
+                    return _constrain(out, mesh, m.new_split)
+                return jax.jit(stat,
+                               donate_argnums=(0,) if donate else ())
+
+            fn = _cached_jit(("filter-stat", m.name, pred, funcs,
+                              base.shape, str(base.dtype), psplit,
+                              m.axes, m.keepdims, m.ddof, donate, mesh),
+                             build)
+            m.result = fn(_check_live(base))
+            return
+
+        slots = sorted({s for m in members for s in _slot(m)}, key=repr)
+        slots = tuple(slots)
+
+        def build():
+            def stat(data):
+                mapped = _chain_apply(funcs, psplit, data)
+                flat = mapped.reshape((n,) + tuple(vshape))
+                mask = _pred_mask(pred, flat)
+                mfull = mask.reshape((n,) + (1,) * len(vshape))
+                outs = []
+                for (name, axes, keepdims, ddof) in slots:
+                    outs.append(_constrain(
+                        _masked_stat_expr(name, flat, mask, mfull, axes,
+                                          keepdims, ddof, vshape,
+                                          vdtype),
+                        mesh, 1 if keepdims else 0))
+                return tuple(outs)
+            return jax.jit(stat, donate_argnums=(0,) if donate else ())
+
+        fn = _cached_jit(("multi-filter-stat", slots, pred, funcs,
+                          base.shape, str(base.dtype), psplit, donate,
+                          mesh), build)
+        with _obs.span("array.multi_stat", terminals=len(members),
+                       slots=len(slots), filtered=True, donate=donate):
+            outs = fn(_check_live(base))
+        _engine.record_fused_stats(len(members))
+        index = {s: i for i, s in enumerate(slots)}
+        for m in members:
+            m.result = outs[index[_slot(m)[0]]]
+
+    def _resolve_stream(self):
+        members = self.members
+        if (len(members) == 1
+                and members[0].name in ("sum", "mean", "var", "std")):
+            # standalone resolution: the exact pre-fusion streamed
+            # terminal (same slab/merge/finalise programs and keys)
+            m = members[0]
+            out = _streamlib.execute(None, m.name, ddof=m.ddof,
+                                     source=self.source)
+            m.result = out.tojax()
+            return
+        specs = tuple((m.name, m.ddof) for m in members)
+        outs = _streamlib.execute(None, "multi", specs=specs,
+                                  source=self.source)
+        if len(members) > 1:
+            _engine.record_fused_stats(len(members))
+        for m, out in zip(members, outs):
+            m.result = out
+
+
+def _new_split(split, axes, keepdims):
+    nkeys = sum(1 for a in axes if a < split)
+    return split if keepdims else split - nkeys
+
+
+def _stat_expr(mapped, name, axes, keepdims, ddof, mode):
+    """The per-terminal reduction expression of the fused program —
+    with ``mode=None`` exactly the standalone terminal's expression
+    (bit-identity of fused vs standalone is parity-locked in
+    tests/test_multistat.py); ``mode`` casts the ADDITIVE terminals'
+    values ("bf16" accumulates in f32 — the accumulate-in-f32 contract;
+    "f32" is exact for f32 pipelines) and leaves order statistics
+    untouched."""
+    op = _OPS[name]
+    kwargs = {} if ddof is None else {"ddof": ddof}
+    if mode is not None and name in _ADDITIVE \
+            and jnp.issubdtype(mapped.dtype, jnp.floating):
+        if mode == "bf16":
+            return op(mapped.astype(jnp.bfloat16), axis=axes,
+                      dtype=jnp.float32, keepdims=keepdims, **kwargs)
+        return op(mapped.astype(jnp.float32), axis=axes,
+                  keepdims=keepdims, **kwargs)
+    return op(mapped, axis=axes, keepdims=keepdims, **kwargs)
+
+
+def _sub_program(shape, dtype, mesh):
+    """``max − min`` for a ``ptp`` member — exactly ``jnp.ptp``'s own
+    arithmetic, as one tiny cached program shared by every ptp of this
+    geometry."""
+    key = ("multi-stat-sub", tuple(shape), str(dtype), mesh)
+
+    def build():
+        return jax.jit(jnp.subtract)
+    return _cached_jit(key, build)
+
+
+# ---------------------------------------------------------------------
+# handle creation (the lazy door _stat calls first)
+# ---------------------------------------------------------------------
+
+def defer_stat(arr, axis, name, keepdims, ddof):
+    """Create (or join) a lazy :class:`PendingStat` for ``arr``'s
+    ``name`` terminal; returns the pending result array, or
+    NotImplemented when this spec must take the eager path (non-lazy
+    name, consumed source without a live group, a geometry the fused
+    machinery does not serve)."""
+    if name not in LAZY_NAMES:
+        return NotImplemented
+    g = arr._stat_group
+    if g is not None and g.dispatched:
+        g = arr._stat_group = None
+    if g is not None and not arr._donated and (
+            (g.kind == "stream" and arr._stream is None)
+            or (g.kind == "fpending" and arr._fpending is None)
+            or (g.kind == "chain" and g.funcs and arr._chain is None)):
+        # the source materialised since the group formed: new terminals
+        # must compute from the CONCRETE data, not re-run the recorded
+        # chain/filter/stream (a one-shot iterator could not stream
+        # again anyway, and re-applying a map chain would silently
+        # double the one-pass cost model); the old group's own members
+        # still resolve from their recorded source.  Donated sources
+        # have no other state — they keep joining their group.
+        g = None
+    if g is not None:
+        h = g.try_join(axis, name, keepdims, ddof)
+        if h is not NotImplemented:
+            return _wrap(arr, g, h)
+        if arr._donated:
+            return NotImplemented     # consumed; eager path raises guard
+        # live source, spec ineligible for the existing group: eager
+        return NotImplemented
+    if arr._donated:
+        return NotImplemented
+    g = _new_group(arr, axis, name, keepdims, ddof)
+    if g is NotImplemented:
+        return NotImplemented
+    arr._stat_group = g
+    return _wrap(arr, g, g.members[0])
+
+
+def _wrap(arr, group, handle):
+    from bolt_tpu.tpu.array import BoltArrayTPU
+    out = BoltArrayTPU(None, handle.new_split, group.mesh)
+    out._aval = handle.aval
+    out._spending = handle
+    return out
+
+
+def _new_group(arr, axis, name, keepdims, ddof):
+    from bolt_tpu.tpu.array import _chain_donate_ok
+    mesh = arr._mesh
+    if arr._stream is not None:
+        g = _StatGroup("stream", mesh, arr._stream.split,
+                       source=arr._stream)
+        if g.try_join(axis, name, keepdims, ddof) is NotImplemented:
+            return NotImplemented
+        return g
+    if arr._fpending is not None:
+        donate = _chain_donate_ok(arr._fpending)     # [0] is the base
+        g = _StatGroup("fpending", mesh, 1, fpending=arr._fpending,
+                       donate=donate)
+        if g.try_join(axis, name, keepdims, ddof) is NotImplemented:
+            return NotImplemented
+        if donate:
+            # today's semantics, kept eager: the first donating
+            # terminal consumes the source; siblings join THIS group
+            # (one donate serves every member)
+            arr._consume_donated("filter().%s()" % name)
+        return g
+    # standard chain / concrete base.  The donation decision runs with
+    # the standalone terminals' exact reference pattern (attribute
+    # access straight into the call — the ownership test is
+    # refcount-based)
+    donate = arr.deferred and _chain_donate_ok(arr._chain)
+    base, funcs = arr._chain_parts()
+    g = _StatGroup("chain", mesh, arr._split, base=base, funcs=funcs,
+                   donate=donate,
+                   in_aval=jax.ShapeDtypeStruct(tuple(arr._aval.shape),
+                                                arr._aval.dtype))
+    if g.try_join(axis, name, keepdims, ddof) is NotImplemented:
+        return NotImplemented
+    if donate:
+        arr._consume_donated("%s()" % name)
+    return g
+
+
+def _chain_member(g, name, axis, keepdims, ddof):
+    from bolt_tpu.tpu.array import _cached_eval_shape
+    shape = tuple(g.in_aval.shape)
+    split = g.split
+    if axis is None:
+        axes = tuple(range(split)) if split else tuple(range(len(shape)))
+    else:
+        axes = tuple(sorted(tupleize(axis)))
+        inshape(shape, axes)
+    if name in ("min", "max", "ptp") \
+            and prod([shape[a] for a in axes]) == 0:
+        return NotImplemented          # zero-size: eager raise contract
+    kwargs = {} if ddof is None else {"ddof": ddof}
+    aval = _cached_eval_shape(
+        ("stat-aval", name, shape, str(g.in_aval.dtype), axes, keepdims,
+         ddof),
+        lambda: jax.eval_shape(
+            lambda x: _OPS[name](x, axis=axes, keepdims=keepdims,
+                                 **kwargs), g.in_aval))
+    return PendingStat(g, name, axes, keepdims, ddof, aval,
+                       _new_split(split, axes, keepdims))
+
+
+def _fpending_member(g, name, axis, keepdims, ddof):
+    _, _, _, _, vshape, n, vdtype = g.fpending
+    if name not in _FPENDING_LAZY:
+        return NotImplemented
+    ndim = 1 + len(vshape)
+    if axis is None:
+        axes = (0,)                    # the flat key axis (split=1)
+    else:
+        axes = tuple(sorted(tupleize(axis)))
+        for a in axes:
+            if not 0 <= a < ndim:
+                return NotImplemented  # let the eager path reject
+    if 0 not in axes:
+        return NotImplemented
+    vdtype = np.dtype(vdtype)
+    if name in ("var", "std") and np.issubdtype(vdtype,
+                                                np.complexfloating):
+        return NotImplemented
+    ref = _OPS[name]
+    kwargs = {} if ddof is None else {"ddof": ddof}
+    aval = jax.eval_shape(
+        lambda x: ref(x, axis=axes, keepdims=keepdims, **kwargs),
+        jax.ShapeDtypeStruct((n,) + tuple(vshape), vdtype))
+    return PendingStat(g, name, axes, keepdims, ddof, aval,
+                       1 if keepdims else 0)
+
+
+def _stream_member(g, name, axis, keepdims, ddof):
+    st = _streamlib.result_state(g.source)
+    if name not in _STREAM_LAZY or keepdims or st.n == 0:
+        return NotImplemented
+    if axis is not None:
+        if tuple(sorted(tupleize(axis))) != tuple(range(st.split)):
+            return NotImplemented
+    if st.pred is not None and name in ("min", "max", "ptp"):
+        # zero survivors would need the materialised error contract
+        return NotImplemented
+    if name in ("mean", "var", "std") and np.issubdtype(
+            st.dtype, np.complexfloating):
+        return NotImplemented          # mirror the fused-filter gate
+    probe = jax.ShapeDtypeStruct((max(st.n, 1),) + tuple(st.vshape),
+                                 st.dtype)
+    kwargs = {} if ddof is None else {"ddof": ddof}
+    aval = jax.eval_shape(
+        lambda x: _OPS[name](x, axis=0, **kwargs), probe)
+    return PendingStat(g, name, tuple(range(st.split)), False, ddof,
+                       aval, 0)
+
+
+# ---------------------------------------------------------------------
+# the public multi-output terminal
+# ---------------------------------------------------------------------
+
+def compute(*stats, accumulate=None):
+    """Resolve pending statistics with as few passes as possible::
+
+        s, v, lo, hi = bolt.compute(a.sum(), a.var(), a.min(), a.max())
+
+    Handles sharing one source (the same deferred chain, deferred
+    filter, or out-of-core stream) dispatch ONE fused tuple program —
+    map/filter stages applied once, one read of the data for the whole
+    group, each result bit-identical to its standalone terminal.
+    Mixed sources fall back per group; already-concrete inputs (any
+    backend) pass through untouched.  Returns the inputs in argument
+    order (a single input comes back bare).
+
+    ``accumulate`` opts the group's additive reductions into the
+    reduced-precision path ("bf16" values with f32 accumulation, or
+    "f32"); default ``None`` is bit-exact.  See
+    :func:`bolt_tpu._precision.accumulate` for the scoped form."""
+    if not stats:
+        raise TypeError("compute() needs at least one statistic")
+    seen, groups = set(), []
+    for s in stats:
+        h = getattr(s, "_spending", None)
+        if h is not None and h.result is None:
+            if id(h.group) not in seen:
+                seen.add(id(h.group))
+                groups.append(h.group)
+    for g in groups:
+        g.resolve(accumulate)
+    if accumulate is not None and not groups:
+        _precision._check_accumulate(accumulate)   # validate even if moot
+    return stats[0] if len(stats) == 1 else tuple(stats)
+
+
+def fluent_stats(arr, names, axis=None, accumulate=None):
+    """``a.stats("sum", "var", "min")`` — the fluent fused multi-stat:
+    one pending handle per name (each exactly the standalone method's
+    spec), resolved together through :func:`compute`, returned as an
+    ordered ``{name: value-shaped array}`` dict."""
+    for n in names:
+        if n not in LAZY_NAMES:
+            raise ValueError(
+                "unknown statistic %r; choose from %s"
+                % (n, ", ".join(LAZY_NAMES)))
+    if arr._stream is not None and any(n not in _STREAM_LAZY
+                                       for n in names):
+        # a name with no streamed fold (prod/all/any) would materialise
+        # the source MID-LIST — consuming a one-shot iterator out from
+        # under the streamed siblings (and double-ingesting re-iterable
+        # sources).  Materialise ONCE up front instead: every name then
+        # computes from the concrete data as one fused chain group.
+        arr.cache()
+    handles = [getattr(arr, n)(axis=axis) for n in names]
+    compute(*handles, accumulate=accumulate)
+    return OrderedDict(zip(names, handles))

@@ -179,6 +179,37 @@ def main():
     assert ec["stream_chunks"] >= 6 and ec["stream_upload_threads"] >= 1
 
     # ------------------------------------------------------------------
+    section("8d. one-pass statistics: bolt.compute fused multi-stat")
+    # four lazy stat terminals on one deferred chain fuse into ONE
+    # tuple-output program (one read of the data); every fused result
+    # is bit-identical to its standalone terminal
+    xm = rs.randn(64, 16, 8).astype(np.float32)
+    chain = bolt.array(xm, mesh).map(lambda v: v * 2.0)
+    c0 = bolt.profile.engine_counters()
+    s8, v8, lo8, hi8 = bolt.compute(chain.sum(), chain.var(),
+                                    chain.min(), chain.max())
+    c1 = bolt.profile.engine_counters()
+    assert c1["dispatches"] - c0["dispatches"] == 1     # ONE pass
+    assert c1["fused_stat_terminals"] - c0["fused_stat_terminals"] == 4
+    sa = bolt.array(xm, mesh).map(lambda v: v * 2.0).sum()
+    assert np.array_equal(np.asarray(s8.toarray()),
+                          np.asarray(sa.toarray()))     # bit-identical
+    # the fluent form works on out-of-core streams too: ONE ingest pass
+    st8 = bolt.fromcallback(lambda idx: xm[idx], xm.shape, mesh,
+                            dtype=np.float32, chunks=16)
+    d8 = st8.stats("sum", "min", "max")
+    assert np.array_equal(np.asarray(d8["min"].toarray()),
+                          xm.min(axis=0))
+    # ptp rides the fused min/max pair; explain() forecasts the fusion
+    assert np.allclose(np.asarray(chain.ptp().toarray()),
+                       np.ptp(xm * 2.0, axis=0), rtol=1e-6)
+    from bolt_tpu import analysis as _analysis
+    chain2 = bolt.array(xm, mesh).map(lambda v: v + 1.0)
+    h1, h2 = chain2.sum(), chain2.var()
+    assert "fusable terminal set" in _analysis.explain(h1)
+    bolt.compute(h1, h2)
+
+    # ------------------------------------------------------------------
     section("9. time-series pipeline: detrend -> zscore -> PCA")
     # per-pixel calcium-imaging-style workflow: remove each pixel's slow
     # drift, standardise, then find the dominant temporal components —

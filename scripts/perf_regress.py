@@ -124,8 +124,11 @@ FILTER_PRED = lambda v: v.mean() > 0
 def fam_map_sum():
     shape = (8192, 256, 256)                      # 2.1 GB f32
     b = bolt.ones(shape, mode="tpu", dtype=np.float32).cache()
+    # .cache() forces the LAZY stat terminal to dispatch (async) so
+    # every queued launch really runs — stat results are pending
+    # fused-group handles since the bolt.compute layer
     return int(np.prod(shape)) * 4, steady_amortized(
-        lambda: b.map(MAPSUM_FN).sum(axis=(0, 1, 2))), {
+        lambda: b.map(MAPSUM_FN).sum(axis=(0, 1, 2)).cache()), {
         "bound": "hbm",
         "traffic": (1.0, "one fused read pass; output is a scalar")}
 
@@ -195,7 +198,7 @@ def fam_filter_sum_fused():
     shape = (14336, 256, 64)                      # 0.94 GB
     b = bolt.randn(shape, mode="tpu", seed=4, dtype=np.float32).cache()
     return int(np.prod(shape)) * 4, steady_amortized(
-        lambda: b.filter(FILTER_PRED).sum(), iters=32), {
+        lambda: b.filter(FILTER_PRED).sum().cache(), iters=32), {
         "bound": "hbm",
         "traffic": (1.0, "single fused mask+reduce pass; the (256, 64) "
                          "output is ~0.003% of the input")}
@@ -377,6 +380,53 @@ def fam_stream_sum():
                          "merge on device, one value block returns")}
 
 
+def fam_multi_stat_fused():
+    # the ISSUE-7 fused multi-stat terminal: bolt.compute(m.sum(),
+    # m.var(), m.min(), m.max()) — four terminals from ONE read of a
+    # >= 1 GB input (the bytes-read model: 1 fused dispatch over the
+    # chain = 1 input pass, vs 4 standalone passes).  The family also
+    # records per-terminal-count scaling (1/2/4 fused terminals): on
+    # HBM-bound hardware the fused time should stay ~flat with N while
+    # the sequential cost grows ~Nx.
+    shape = (8192, 256, 128)                      # 1.07 GB f32
+    b = bolt.ones(shape, mode="tpu", dtype=np.float32).cache()
+
+    def launch_n(n):
+        m = b.map(MAPSUM_FN)
+        hs = [m.sum(), m.var(), m.min(), m.max()][:n]
+        bolt.compute(*hs)
+        return hs[-1]
+
+    def launch_seq():
+        # the pre-fusion cost model: resolve one terminal at a time,
+        # each singleton group dispatching its own standalone pass
+        m = b.map(MAPSUM_FN)
+        m.sum().cache()
+        m.var().cache()
+        m.min().cache()
+        return m.max().cache()
+
+    scaling = {}
+    for n in (1, 2, 4):
+        scaling[str(n)] = round(
+            steady_amortized(lambda n=n: launch_n(n), iters=8), 5)
+    sec = scaling["4"]
+    seq4 = steady_amortized(launch_seq, iters=8)
+    ec = bolt.profile.engine_counters()
+    return int(np.prod(shape)) * 4, sec, {
+        "bound": "hbm",
+        "terminals": 4,
+        "sequential_4_s": round(seq4, 5),
+        "seq_over_fused": round(seq4 / sec, 2),
+        "terminal_scaling_s": scaling,
+        "fused_stat_groups": ec["fused_stat_groups"],
+        "fused_stat_terminals": ec["fused_stat_terminals"],
+        "traffic": (1.0, "ONE fused read pass serves all 4 terminals "
+                         "(sum/var/min/max); the sequential form costs "
+                         "4 passes — the bytes-read model the "
+                         "multi_stat_fused bench gate enforces")}
+
+
 def fam_pca_default():
     # the SAME pca program under the bolt.precision("default") scope —
     # PERF.json records both policy modes for the precision-bound
@@ -407,6 +457,7 @@ FAMILIES = [
     ("svdvals", fam_svdvals),
     ("jacobi_eigh", fam_jacobi_eigh),
     ("stream_sum", fam_stream_sum),
+    ("multi_stat_fused", fam_multi_stat_fused),
 ]
 
 
@@ -521,7 +572,9 @@ def main():
                  # platform in spirit)
                  "platform": jax.default_backend()}
         for key in ("upload_threads", "inflight_high_water",
-                    "prefetch_depth"):
+                    "prefetch_depth", "terminals", "terminal_scaling_s",
+                    "sequential_4_s", "seq_over_fused",
+                    "fused_stat_groups", "fused_stat_terminals"):
             if meta.get(key) is not None:
                 entry[key] = meta[key]
         if phases:

@@ -111,8 +111,10 @@ def test_device_served_no_gather(mesh, monkeypatch):
         lambda self, dtype=None: (_ for _ in ()).throw(
             AssertionError("implicit __array__!")))
     with profile.instrument() as stats:
-        out = np.sum(b)
-        np.mean(b, axis=0)
+        # .cache() dispatches each LAZY stat on device — still no
+        # toarray/__array__ anywhere in the path
+        out = np.sum(b).cache()
+        np.mean(b, axis=0).cache()
         np.sort(b, axis=1)
         np.concatenate([b, b], axis=2)
     assert out.mode == "tpu" and out.split == 0

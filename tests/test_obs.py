@@ -203,7 +203,7 @@ def test_stream_fault_leaves_no_open_spans(mesh):
 
     src = bolt.fromiter(bad_blocks(), (8, 8), mesh, dtype=np.float64)
     with pytest.raises(RuntimeError, match="mid-stream failure"):
-        src.sum()
+        src.sum().cache()                  # the read streams (lazy)
     assert obs.active_count() == 0
 
 
@@ -304,6 +304,7 @@ _EXPECTED_ENGINE_KEYS = {
     "stream_compute_seconds": True, "stream_wall_seconds": True,
     "stream_overlap_seconds": True, "stream_prefetch_depth": False,
     "stream_upload_threads": False, "stream_inflight_high_water": False,
+    "fused_stat_groups": False, "fused_stat_terminals": False,
 }
 
 

@@ -308,9 +308,10 @@ def test_strict_refuses_error_findings_before_any_compile(mesh):
     c1 = engine.counters()
     _no_new_compiles(c0, c1)               # refused BEFORE compiling
     assert c1["strict_rejections"] >= c0["strict_rejections"] + 2
-    # outside the scope the gate is disarmed: the failure is jax's own
+    # outside the scope the gate is disarmed: the failure is jax's own,
+    # surfacing at the lazy terminal's first read
     with pytest.raises(Exception):
-        bad.sum()
+        bad.sum().cache()
 
 
 def test_strict_gates_views_and_filters(mesh):

@@ -228,10 +228,10 @@ def test_fromiter_parity_and_errors(mesh):
         bolt.fromiter(blocks, SHAPE, mesh)
     with pytest.raises(ValueError, match="cover only"):
         bolt.fromiter([data[0:5]], SHAPE, mesh,
-                      dtype=np.float64).sum()
+                      dtype=np.float64).sum().cache()
     with pytest.raises(ValueError, match="overrun"):
         bolt.fromiter([data, data[:1]], SHAPE, mesh,
-                      dtype=np.float64).sum()
+                      dtype=np.float64).sum().cache()
 
 
 def test_stream_map_dtype_and_cast_stage(mesh):
@@ -258,7 +258,7 @@ def test_fromcallback_explicit_dtype_is_lazy(mesh):
     b = bolt.fromcallback(loader, SHAPE, mesh, dtype=np.float64, chunks=4)
     assert b.streaming and calls == []          # nothing produced yet
     assert b.shape == SHAPE and b.dtype == np.float64 and calls == []
-    b.sum()                                     # streams: 4 slabs
+    b.sum().cache()                             # the read streams: 4 slabs
     assert len(calls) == 4
     assert all(isinstance(s, slice) for idx in calls for s in idx)
     calls.clear()
@@ -295,7 +295,7 @@ def test_stream_counters_and_compile_once(mesh):
 
     c0 = engine.counters()
     src = _source(data, mesh, 3)                # 4 even slabs
-    out = src.map(add_one).sum()
+    out = src.map(add_one).sum().cache()        # the read streams (lazy)
     c1 = engine.counters()
     d = {k: c1[k] - c0[k] for k in c1}
     assert d["stream_chunks"] == 4
@@ -314,7 +314,7 @@ def test_stream_counters_and_compile_once(mesh):
     assert d["stream_wall_seconds"] > 0
     # a second identical run reuses ALL executables: zero new compiles
     c2 = engine.counters()
-    out2 = _source(data, mesh, 3).map(add_one).sum()
+    out2 = _source(data, mesh, 3).map(add_one).sum().cache()
     c3 = engine.counters()
     d2 = {k: c3[k] - c2[k] for k in c3}
     assert d2["misses"] == 0 and d2["aot_compiles"] == 0
@@ -361,7 +361,7 @@ def test_stream_overlap_efficiency_positive(mesh):
     d = None
     for _ in range(3):
         c0 = engine.counters()
-        src.map(heavy).sum()
+        src.map(heavy).sum().cache()
         c1 = engine.counters()
         d = {k: c1[k] - c0[k] for k in c1}
         assert d["stream_chunks"] == 6
@@ -395,7 +395,7 @@ def test_stream_fault_mid_stream_aborts_cleanly(mesh):
                             chunks=4)
     threads_before = threading.active_count()
     with pytest.raises(RuntimeError) as ei:
-        src.sum()
+        src.sum().cache()                       # the read streams (lazy)
     assert ei.value is boom                     # the ORIGINAL exception
     # prefetch thread joined, no leak
     assert stream._LAST_THREAD is not None
@@ -410,7 +410,7 @@ def test_stream_fault_bad_block_shape(mesh):
     bad = bolt.fromcallback(lambda idx: np.zeros((1, 1)), SHAPE, mesh,
                             dtype=np.float64, chunks=4)
     with pytest.raises(ValueError, match="returned shape"):
-        bad.sum()
+        bad.sum().cache()
     assert not stream._LAST_THREAD.is_alive()
 
 
@@ -448,13 +448,13 @@ def test_fromiter_exhausted_restream_raises_pointed_error(mesh):
     first = np.asarray(src.sum().toarray())
     assert np.array_equal(first, data.sum(axis=0))
     with pytest.raises(RuntimeError, match="already streamed"):
-        src.sum()
+        src.sum().cache()
     # derived sources share the iterator (with_stage), so the budget is
     # shared too
     src2 = bolt.fromiter(gen(), SHAPE, mesh, dtype=np.float64)
-    src2.map(lambda v: v * 2).sum()
+    src2.map(lambda v: v * 2).sum().cache()
     with pytest.raises(RuntimeError, match="already streamed"):
-        src2.sum()
+        src2.sum().cache()
     # RE-ITERABLE sources (a list of blocks) stream repeatedly — the
     # guard is for one-shot iterators only
     lst = bolt.fromiter([data], SHAPE, mesh, dtype=np.float64)
@@ -689,7 +689,7 @@ def test_stream_fault_in_uploader_worker_aborts_cleanly(mesh,
     src = _source(data, mesh, 4)
     with stream.uploaders(2):
         with pytest.raises(RuntimeError) as ei:
-            src.sum()
+            src.sum().cache()
     assert ei.value is boom                     # the ORIGINAL exception
     # the WHOLE pool (dispenser + workers) is joined, nothing leaks
     assert stream._LAST_POOL
@@ -715,17 +715,17 @@ def test_stream_dead_pool_thread_raises_pointed_error(mesh, monkeypatch):
     src = bolt.fromcallback(dying, SHAPE, mesh, dtype=np.float64,
                             chunks=4)
     with pytest.raises(RuntimeError, match="died without delivering"):
-        src.sum()
+        src.sum().cache()
     with pytest.raises(RuntimeError, match="bolt-stream"):
         bolt.fromcallback(dying, SHAPE, mesh, dtype=np.float64,
-                          chunks=4).sum()
+                          chunks=4).sum().cache()
     # the harder shape: MORE slabs than the ring, so the dispenser is
     # still alive, blocked on ring permits, when every worker dies —
     # dead workers must trip the guard anyway (nothing can ever arrive)
     with stream.uploaders(2), stream.prefetch(1):   # ring 3 << 16 slabs
         with pytest.raises(RuntimeError, match="died without delivering"):
             bolt.fromcallback(dying, SHAPE, mesh, dtype=np.float64,
-                              chunks=1).sum()
+                              chunks=1).sum().cache()
 
 
 def test_stream_inflight_window_bounds_and_records(mesh):
