@@ -30,7 +30,8 @@ __all__ = ["array", "ones", "zeros", "full", "rand", "randn",
            "BoltArrayTPU", "HostFallbackWarning", "__version__"]
 
 _SUBMODULES = ("analysis", "checkpoint", "engine", "obs", "profile",
-               "parallel", "ops", "statcounter", "stream", "utils")
+               "parallel", "ops", "serve", "statcounter", "stream",
+               "utils")
 
 
 def __getattr__(name):

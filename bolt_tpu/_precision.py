@@ -110,13 +110,23 @@ def resolve(explicit=None, pinned="highest"):
 #   relative accuracy envelope (parity-locked at that tolerance in
 #   tests/test_multistat.py).
 #
+# - "int8": the INTEGER twin of the bf16 path — values of an
+#   integer-dtype pipeline cast to int8, accumulated in int32 (the
+#   accumulate-in-i32 contract; results int32).  Applies to the integer
+#   additive terminals (sum/prod) only: mean/var/std are float-valued
+#   and ignore it, as do float pipelines.  The documented envelope is
+#   EXACT integer arithmetic for values in int8 range ([-128, 127]) —
+#   out-of-range values wrap (two's complement), which is the caller's
+#   contract to uphold (parity-locked in tests/test_multistat.py
+#   alongside the bf16 suite).
+#
 # min/max/any/all (and the min/max pair behind ptp) are exact order
 # statistics and ignore the mode.  Scoped like bolt.precision
 # (thread-local, innermost wins); the per-call door is
 # ``bolt.compute(..., accumulate=...)``.
 # ---------------------------------------------------------------------
 
-ACCUMULATE_MODES = ("bf16", "f32")
+ACCUMULATE_MODES = ("bf16", "f32", "int8")
 
 _acc_tls = threading.local()
 

@@ -36,11 +36,13 @@ import threading
 from bolt_tpu import engine as _engine
 from bolt_tpu.analysis.diagnostics import (CODES, Diagnostic,
                                            PipelineError, Report, Stage)
-from bolt_tpu.analysis.check import check, explain
+from bolt_tpu.analysis.check import (admission_floor_bytes, check,
+                                     explain, working_set_bytes)
 from bolt_tpu.analysis import astlint
 
 __all__ = ["check", "explain", "strict", "in_strict", "CODES",
-           "Diagnostic", "Report", "Stage", "PipelineError", "astlint"]
+           "Diagnostic", "Report", "Stage", "PipelineError", "astlint",
+           "working_set_bytes", "admission_floor_bytes"]
 
 _tls = threading.local()
 _ACTIVE = 0                       # strict scopes alive across ALL threads

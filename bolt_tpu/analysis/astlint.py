@@ -39,6 +39,14 @@ Rules (the ``BLT1xx`` range; the abstract pipeline checker owns
   removes; synchronisation belongs to the executor's bounded in-flight
   window, the counted transfer layer, and the profiling barriers, not
   to op code.
+* **BLT108** — no raw ``threading.Thread`` / pool-executor construction
+  outside ``stream.py`` and ``serve.py``.  Concurrency has exactly two
+  blessed homes: the streaming executor's uploader pool and the
+  serving layer's scheduler — both arbiter-aware, fault-funnelled and
+  obs-instrumented.  A stray thread elsewhere bypasses the
+  device-memory budget, the tenant counter scoping and the liveness
+  guards (locks, events, and conditions are fine; it is thread
+  *construction* that must be centralised).
 
 A finding on line *N* is suppressed when that line carries a
 ``# lint: allow(BLT1xx <reason>)`` pragma — the escape hatch for the
@@ -60,6 +68,7 @@ RULES = {
     "BLT105": "raw jax.device_put outside the stream transfer layer",
     "BLT106": "raw time.perf_counter bookkeeping outside bolt_tpu.obs",
     "BLT107": "stray block_until_ready sync point outside the executor",
+    "BLT108": "raw thread/executor construction outside stream.py/serve.py",
 }
 
 # rule -> path suffixes (os-normalised) exempt from it; an entry ending
@@ -77,6 +86,23 @@ _EXEMPT = {
     # the executor's window/transfer syncs, the engine's AOT plumbing,
     # and profile's timing barriers are the sanctioned sync points
     "BLT107": ("stream.py", "engine.py", "profile.py"),
+    # the two blessed concurrency homes: the uploader pool + the
+    # multi-tenant scheduler
+    "BLT108": ("stream.py", "serve.py"),
+}
+
+# constructors BLT108 forbids outside the blessed homes (dotted, alias-
+# resolved like every other chain rule)
+_THREAD_CONSTRUCTORS = {
+    "threading.Thread",
+    "threading.Timer",
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+    "concurrent.futures.thread.ThreadPoolExecutor",
+    "concurrent.futures.process.ProcessPoolExecutor",
+    "multiprocessing.pool.ThreadPool",
+    "multiprocessing.pool.Pool",
+    "multiprocessing.Process",
 }
 
 _VERSION_SENSITIVE = {
@@ -346,6 +372,17 @@ def lint_source(src, path="<string>"):
                  "pipeline (the perf hazard the streaming executor's "
                  "bounded in-flight window exists to remove); let the "
                  "executor/profiling layers own synchronisation")
+
+        # ---- BLT108: raw thread/executor construction ------------------
+        if isinstance(node, ast.Call) \
+                and resolved(node.func) in _THREAD_CONSTRUCTORS:
+            emit("BLT108", node,
+                 "%s constructed outside the blessed concurrency homes "
+                 "(stream.py's uploader pool, serve.py's scheduler); a "
+                 "stray thread bypasses the device-memory arbiter, the "
+                 "per-tenant counter scoping and the liveness guards — "
+                 "route the work through bolt_tpu.serve.submit or the "
+                 "streaming executor" % resolved(node.func))
 
         # ---- BLT106: raw perf_counter bookkeeping outside obs ----------
         if isinstance(node, ast.Call) \

@@ -32,6 +32,8 @@ shape/dtype cannot drift (``tests/test_pipeline_fuzz.py`` asserts this
 parity on every fuzzed pipeline).
 """
 
+import sys
+
 import numpy as np
 
 import jax
@@ -133,6 +135,114 @@ def _group_bytes(g):
     return prod(g.source.shape) * np.dtype(g.source.dtype).itemsize
 
 
+# ---------------------------------------------------------------------
+# admission budget (the serving layer's BLT010 contract)
+# ---------------------------------------------------------------------
+
+def _stream_slab_bytes(src):
+    return int(src.slab * prod(src.shape[1:]) * src.dtype.itemsize)
+
+
+def _stream_ring_bytes(src):
+    """A streaming plan's peak device footprint: slab bytes times the
+    donated-ring bound (prefetch depth + uploader pool) — exactly the
+    budget one run's slabs can hold at once in ``stream.execute``."""
+    from bolt_tpu import stream as _stream
+    return _stream_slab_bytes(src) * (_stream.prefetch_depth()
+                                      + _stream.pool_size(src))
+
+
+def _admission_budget():
+    """The ACTIVE serving arbiter's byte budget, or None when
+    ``bolt_tpu.serve`` is not running (consulted via ``sys.modules`` so
+    checking never imports the serving layer)."""
+    sv = sys.modules.get("bolt_tpu.serve")
+    if sv is None:
+        return None
+    arb = sv.device_arbiter()
+    return arb.budget if arb is not None else None
+
+
+def _note_admission(est, idx, diags):
+    """``BLT010``: the pipeline's MINIMUM device working set — the
+    floor it can degrade to under budget pressure (one slab for
+    streams; the whole base + result for in-memory pipelines) — exceeds
+    the serving budget: ``serve.submit`` rejects it, because a worker
+    that admitted it would hog or wedge the arbiter forever."""
+    budget = _admission_budget()
+    if budget is None or est is None or est <= budget:
+        return
+    diags.append(Diagnostic(
+        "BLT010", idx,
+        "minimum device working set ~%s exceeds the serving admission "
+        "budget %s even fully degraded; serve.submit will reject this "
+        "pipeline" % (_fmt_bytes(int(est)), _fmt_bytes(int(budget))),
+        hint="shrink the operand or streaming slabs "
+             "(fromcallback(chunks=...)), or start the server with a "
+             "larger budget_bytes"))
+
+
+def admission_floor_bytes(obj):
+    """The MINIMUM device bytes ``obj``'s pipeline needs at once — the
+    number admission control (``serve.submit`` / BLT010) compares
+    against the serving budget.  Streaming plans degrade to ONE slab in
+    flight (the arbiter's starvation valve shallows the ring), so their
+    floor is the slab size; in-memory pipelines cannot shrink, so their
+    floor is :func:`working_set_bytes`.  None when nothing can be
+    estimated."""
+    from bolt_tpu.tpu.array import BoltArrayTPU
+    from bolt_tpu.tpu.chunk import ChunkedArray
+    from bolt_tpu.tpu.stack import StackedArray
+    arr = obj
+    if isinstance(arr, (ChunkedArray, StackedArray)):
+        arr = arr._barray
+    if not isinstance(arr, BoltArrayTPU):
+        return None
+    if arr._spending is not None and arr._spending.group.kind == "stream":
+        return _stream_slab_bytes(arr._spending.group.source)
+    if arr._stream is not None:
+        return _stream_slab_bytes(arr._stream)
+    return working_set_bytes(arr)
+
+
+def working_set_bytes(obj):
+    """Estimated PEAK device bytes ``obj``'s pipeline needs at once —
+    the number admission control compares against the serving budget:
+
+    * streaming plan → slab bytes x (prefetch depth + uploader pool),
+      the donated-ring bound;
+    * pending stat group → the group's one-pass read (stream groups use
+      the ring bound);
+    * deferred chain / filter / concrete array → source bytes + result
+      bytes (input and output coexist during the dispatch).
+
+    Returns ``None`` for objects with nothing to estimate (local
+    arrays)."""
+    from bolt_tpu.tpu.array import BoltArrayTPU
+    from bolt_tpu.tpu.chunk import ChunkedArray
+    from bolt_tpu.tpu.stack import StackedArray
+    arr = obj
+    if isinstance(arr, (ChunkedArray, StackedArray)):
+        arr = arr._barray
+    if not isinstance(arr, BoltArrayTPU):
+        return None
+    if arr._spending is not None:
+        g = arr._spending.group
+        if g.kind == "stream":
+            return _stream_ring_bytes(g.source)
+        return int(_group_bytes(g))
+    if arr._stream is not None:
+        return _stream_ring_bytes(arr._stream)
+    aval = arr._aval
+    out_bytes = (prod(tuple(aval.shape)) * np.dtype(aval.dtype).itemsize
+                 if aval is not None else 0)
+    if arr._fpending is not None:
+        return int(arr._fpending[0].nbytes) + int(out_bytes)
+    if arr._chain is not None:
+        return int(arr._chain[0].nbytes) + int(out_bytes)
+    return int(out_bytes)
+
+
 def _note_fusable(arr, idx, diags):
     """``BLT009``: forecast the single-pass fusion — this array's
     source carries a live fused stat group (bolt_tpu/tpu/multistat.py),
@@ -173,6 +283,8 @@ def _check_spending(arr, target, stages, diags):
         note="terminal of a %d-member fused group, not yet dispatched"
              % len(g.members)))
     _note_fusable_group(g, 1, diags)
+    _note_admission(_stream_slab_bytes(g.source) if g.kind == "stream"
+                    else _group_bytes(g), 1, diags)
     return Report(target + ", pending stat", stages, diags)
 
 
@@ -441,6 +553,13 @@ def _check_impl(obj):
             "and syncs one scalar" % n))
         dynamic = True
 
+    if not failed:
+        _note_admission(
+            int(base.nbytes)
+            + prod(tuple(stages[-1].shape))
+            * np.dtype(stages[-1].dtype).itemsize,
+            len(stages) - 1, diags)
+
     if will_donate and not failed:
         nbytes = int(base.nbytes)
         diags.append(Diagnostic(
@@ -477,6 +596,7 @@ def _check_stream(arr, target, stages, diags):
              "uploader pool %d"
              % (nslabs, src.slab, _stream.prefetch_depth(),
                 _stream.pool_size(src))))
+    _note_admission(_stream_slab_bytes(src), 0, diags)
     idle_seen = _idle_device_check(mesh, aval.shape, walk_split, 0, diags,
                                    False)
     dynamic = False

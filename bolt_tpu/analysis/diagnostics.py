@@ -28,6 +28,7 @@ CODES = {
     "BLT007": ("error", "filter predicate is not a scalar per record"),
     "BLT008": ("info", "result shape is dynamic until a count sync"),
     "BLT009": ("info", "fusable terminal set: one pass serves N stats"),
+    "BLT010": ("error", "pipeline exceeds the serving admission budget"),
 }
 
 SEVERITIES = ("error", "warning", "info")

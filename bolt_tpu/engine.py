@@ -75,6 +75,8 @@ _DONATE_MIN_BYTES = int(os.environ.get("BOLT_DONATE_MIN_BYTES",
 
 _LOCK = threading.RLock()            # guards the executable cache
 _CACHE = OrderedDict()               # key -> _Entry
+_BUILDING = {}                       # key -> Event: in-flight builds, so
+                                     # concurrent same-key misses coalesce
 
 # The engine counters live in the bolt_tpu.obs.metrics registry as the
 # counter group named "engine" (PR 4): same keys, same int/float types,
@@ -130,9 +132,71 @@ _SCHEMA = {
     "fused_stat_groups": 0,       # multi-terminal fused dispatches
     "fused_stat_terminals": 0,    # terminals served by those dispatches
                                   # (terminals - groups = dispatches saved)
+    # cross-tenant coalescing proof (bolt_tpu.serve: N tenants running
+    # the same pipeline shape must compile ONCE) — lookups that WAITED
+    # for a concurrent identical build/compile instead of duplicating it
+    "coalesced_builds": 0,        # get() calls that joined an in-flight
+                                  # build of the same key
+    "coalesced_compiles": 0,      # dispatches that joined an in-flight
+                                  # lower+compile of the same signature
 }
 
 _COUNTERS = _metrics.registry().group("engine", _SCHEMA)
+
+# ---------------------------------------------------------------------
+# per-tenant counter scoping (bolt_tpu.serve)
+# ---------------------------------------------------------------------
+#
+# A `tenant(name)` scope tags the calling thread; while active, every
+# engine-counter increment ALSO lands in the registry group
+# "engine/<name>" (same schema, same lock — CounterGroup.set_mirror), so
+# a multi-tenant server can attribute transfer bytes, compiles and
+# dispatches per tenant without a second accounting seam.  The scope is
+# thread-local; bolt_tpu.stream propagates it into its uploader-pool
+# threads so a streamed run's ingest traffic is attributed to the tenant
+# that submitted it.
+
+_TENANT_TLS = threading.local()
+
+
+def current_tenant():
+    """The calling thread's active tenant tag (``None`` outside any
+    :func:`tenant` scope)."""
+    return getattr(_TENANT_TLS, "name", None)
+
+
+@contextlib.contextmanager
+def tenant(name):
+    """Scope the calling thread's tenant tag::
+
+        with bolt_tpu.engine.tenant("team-a"):
+            pipeline.sum().toarray()     # counters also land in
+                                         # engine.tenant_counters("team-a")
+
+    ``tenant(None)`` clears the tag inside the scope."""
+    old = getattr(_TENANT_TLS, "name", None)
+    _TENANT_TLS.name = None if name is None else str(name)
+    try:
+        yield
+    finally:
+        _TENANT_TLS.name = old
+
+
+def _tenant_group():
+    name = getattr(_TENANT_TLS, "name", None)
+    if name is None:
+        return None
+    return _metrics.registry().group("engine/%s" % name, _SCHEMA)
+
+
+_COUNTERS.set_mirror(_tenant_group)
+
+
+def tenant_counters(name):
+    """Consistent snapshot of tenant ``name``'s engine counters (the
+    ``"engine/<name>"`` registry group — all zeros until a
+    :func:`tenant` scope for that name does counted work)."""
+    return _metrics.registry().group("engine/%s" % name, _SCHEMA).snapshot()
 
 # latency/size distributions riding on the same registry lock: the
 # counters above give totals, these give shape (log2 buckets — see
@@ -400,6 +464,19 @@ def record_stream(chunks, ingest_s, compute_s, wall_s, overlap_s, depth,
 # the keyed AOT dispatch path
 # ---------------------------------------------------------------------
 
+# ONE blessed enqueue order for executables.  A single process driving a
+# multi-device mesh from SEVERAL threads (the multi-tenant serving
+# layer) can enqueue two collective programs onto the per-device queues
+# in different orders per device — device 0 sees run A then B, device 1
+# sees B then A — and the cross-device rendezvous (psum/all_to_all)
+# deadlocks with every participant waiting for a different run.  This
+# lock serialises only the ENQUEUE (dispatch is async; execution still
+# overlaps), so all device queues observe one global program order and
+# the rendezvous always completes.  Measured µs-scale per launch; the
+# slow paths (lower/compile) run OUTSIDE it.
+_ORDER_LOCK = threading.RLock()
+
+
 def _leaf_sig(x):
     """Signature of one argument leaf: enough to pick a compiled
     executable — aval (shape/dtype) plus sharding for device arrays,
@@ -420,11 +497,15 @@ class _Dispatch:
     signature; falls back to plain jit dispatch for argument structures
     the AOT path cannot serve (and counts the fallback)."""
 
-    __slots__ = ("jitted", "compiled")
+    __slots__ = ("jitted", "compiled", "_compile_lock")
 
     def __init__(self, jitted):
         self.jitted = jitted
         self.compiled = {}           # signature -> compiled executable
+        # serialises the per-signature lower+compile: N tenants racing
+        # the same signature must produce ONE aot compile (the losers
+        # wait and count coalesced_compiles), not N identical XLA runs
+        self._compile_lock = threading.Lock()
 
     def lower(self, *args, **kwargs):
         """Delegate to the wrapped jitted callable so cached entries stay
@@ -447,7 +528,8 @@ class _Dispatch:
     def _dispatch(self, args):
         if not _AOT:
             _COUNTERS.add("fallbacks")
-            return self.jitted(*args)
+            with _ORDER_LOCK:
+                return self.jitted(*args)
         try:
             leaves, treedef = jax.tree_util.tree_flatten(args)
             sig = (treedef, tuple(_leaf_sig(x) for x in leaves))
@@ -456,29 +538,39 @@ class _Dispatch:
         if sig is not None:
             fn = self.compiled.get(sig)
             if fn is None:
-                try:
-                    lsp = _obs.begin("engine.lower")
-                    try:
-                        t0 = _clock()
-                        lowered = self.jitted.lower(*args)
-                        t1 = _clock()
-                    finally:
-                        _obs.end(lsp)
-                    csp = _obs.begin("engine.compile")
-                    try:
-                        fn = lowered.compile()
-                        t2 = _clock()
-                    finally:
-                        _obs.end(csp)
-                    _COUNTERS.update(aot_compiles=1,
-                                     lower_seconds=t1 - t0,
-                                     compile_seconds=t2 - t1)
-                    self.compiled[sig] = fn
-                except Exception:
-                    fn = None
+                with self._compile_lock:
+                    # a concurrent identical dispatch may have compiled
+                    # while this one waited for the lock: join its
+                    # executable instead of running XLA again — the
+                    # cross-tenant ONE-compile guarantee
+                    fn = self.compiled.get(sig)
+                    if fn is not None:
+                        _COUNTERS.add("coalesced_compiles")
+                    else:
+                        try:
+                            lsp = _obs.begin("engine.lower")
+                            try:
+                                t0 = _clock()
+                                lowered = self.jitted.lower(*args)
+                                t1 = _clock()
+                            finally:
+                                _obs.end(lsp)
+                            csp = _obs.begin("engine.compile")
+                            try:
+                                fn = lowered.compile()
+                                t2 = _clock()
+                            finally:
+                                _obs.end(csp)
+                            _COUNTERS.update(aot_compiles=1,
+                                             lower_seconds=t1 - t0,
+                                             compile_seconds=t2 - t1)
+                            self.compiled[sig] = fn
+                        except Exception:
+                            fn = None
             if fn is not None:
                 try:
-                    return fn(*args)
+                    with _ORDER_LOCK:
+                        return fn(*args)
                 except (TypeError, ValueError):
                     # argument-validation drift the leaf model missed
                     # (layouts, committed-device nuances) — raised BEFORE
@@ -489,7 +581,14 @@ class _Dispatch:
                     # work and bury the real error.
                     pass
         _COUNTERS.add("fallbacks")
-        return self.jitted(*args)
+        # NOTE: a COLD fallback traces+compiles inside jit's first call,
+        # i.e. under the order lock — unavoidable here because plain jit
+        # dispatch fuses compile and enqueue.  Fallbacks are rare by
+        # construction (unhashable leaves, argument-validation drift) and
+        # BOLT_ENGINE_AOT=0 is an explicit single-user debug mode; the
+        # hot AOT path above compiles OUTSIDE the lock.
+        with _ORDER_LOCK:
+            return self.jitted(*args)
 
 
 def get(key, builder):
@@ -502,33 +601,60 @@ def get(key, builder):
     closure captures only geometry — never arrays (cached entries must
     not pin device memory).  ``key`` must be hashable and must determine
     the traced program (op tag, user funcs, shapes, dtypes, split, mesh,
-    precision, donation flag, ...)."""
-    with _LOCK:
-        entry = _CACHE.get(key)
-        if entry is not None:
-            _COUNTERS.add("hits")
-            _CACHE.move_to_end(key)
-            return entry
-    _COUNTERS.add("misses")
+    precision, donation flag, ...).
+
+    Concurrent misses on the SAME key coalesce: the first caller builds,
+    the rest wait on its in-flight marker and adopt the winner's entry
+    (counted as ``coalesced_builds``) — so N tenants dispatching an
+    identical cold pipeline trace and compile it exactly once.  A failed
+    build wakes the waiters, which then build for themselves (the
+    original exception propagates to the owner alone)."""
+    waited = False                      # each lookup counts exactly ONCE:
+    while True:                         # hit, miss, or coalesced wait
+        with _LOCK:
+            entry = _CACHE.get(key)
+            if entry is not None:
+                if not waited:
+                    _COUNTERS.add("hits")
+                _CACHE.move_to_end(key)
+                return entry
+            ev = _BUILDING.get(key)
+            if ev is None:
+                ev = _BUILDING[key] = threading.Event()
+                break                   # this thread owns the build
+            if not waited:
+                _COUNTERS.add("coalesced_builds")
+                waited = True
+        ev.wait()
+        # the owner either inserted the entry (the re-check above finds
+        # it) or failed (loop again: this thread may become the owner)
+    if not waited:
+        _COUNTERS.add("misses")
     # build OUTSIDE the lock: builders may trace (slow) and re-enter
     sp = _obs.begin("engine.build")
     if sp is not None and isinstance(key, tuple) and key:
         sp.set(family=str(key[0]))
     try:
         entry = _Dispatch(builder())
+    except BaseException:
+        with _LOCK:
+            _BUILDING.pop(key, None)
+        ev.set()                        # waiters retry (and may rebuild)
+        raise
     finally:
         _obs.end(sp)
     with _LOCK:
-        # a concurrent miss may have built and inserted first; keep the
-        # WINNER (it may already hold compiled executables) and discard
-        # this build, or a third thread would compile yet again
+        # an evict/clear may have raced; insert (or adopt) under the lock
         existing = _CACHE.get(key)
         if existing is not None:
             _CACHE.move_to_end(key)
-            return existing
-        _CACHE[key] = entry
-        if len(_CACHE) > CACHE_MAX:
-            _CACHE.popitem(last=False)
+            entry = existing
+        else:
+            _CACHE[key] = entry
+            if len(_CACHE) > CACHE_MAX:
+                _CACHE.popitem(last=False)
+        _BUILDING.pop(key, None)
+    ev.set()
     return entry
 
 

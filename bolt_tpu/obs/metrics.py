@@ -176,30 +176,59 @@ class CounterGroup:
     any number of deltas (and optional high-water maxima) atomically —
     the multi-key form the engine's streaming tally needs — and
     :meth:`snapshot` returns a plain dict copied under the same lock, so
-    a reader can never observe a half-applied update."""
+    a reader can never observe a half-applied update.
 
-    __slots__ = ("name", "_lock", "_schema", "_vals")
+    :meth:`set_mirror` installs a scoping hook: a zero-arg provider
+    returning another ``CounterGroup`` (or ``None``) consulted on EVERY
+    increment, which then receives the same deltas under the same lock —
+    the mechanism behind per-tenant engine-counter scoping
+    (``bolt_tpu.engine.tenant``): the provider reads a thread-local
+    tenant tag and returns that tenant's group, so the global tally and
+    the tenant tally can never disagree about one update."""
+
+    __slots__ = ("name", "_lock", "_schema", "_vals", "_mirror")
 
     def __init__(self, name, lock, schema):
         self.name = name
         self._lock = lock
         self._schema = dict(schema)
         self._vals = dict(schema)
+        self._mirror = None
+
+    def set_mirror(self, provider):
+        """Install (or clear, with ``None``) the mirror provider — a
+        callable returning a sibling ``CounterGroup`` (same schema) or
+        ``None``; it runs under the registry lock, so it must only do
+        registry lookups (the lock is re-entrant)."""
+        self._mirror = provider
+
+    def _mirror_group(self):
+        p = self._mirror
+        if p is None:
+            return None
+        m = p()
+        return m if m is not self else None     # never self-mirror
 
     def add(self, key, n=1):
         with self._lock:
             self._vals[key] += n
+            m = self._mirror_group()
+            if m is not None:
+                m._vals[key] += n
 
     def update(self, _maxima=None, **deltas):
         """Atomically add every ``key=delta``; ``_maxima`` entries keep
         ``max(current, value)`` instead (prefetch-depth high-water)."""
         with self._lock:
-            for k, v in deltas.items():
-                self._vals[k] += v
-            if _maxima:
-                for k, v in _maxima.items():
-                    if v > self._vals[k]:
-                        self._vals[k] = v
+            for grp in (self, self._mirror_group()):
+                if grp is None:
+                    continue
+                for k, v in deltas.items():
+                    grp._vals[k] += v
+                if _maxima:
+                    for k, v in _maxima.items():
+                        if v > grp._vals[k]:
+                            grp._vals[k] = v
 
     def __getitem__(self, key):
         with self._lock:

@@ -1,0 +1,718 @@
+"""Multi-tenant serving layer: MANY pipelines, ONE engine, shared HBM.
+
+Everything below this module optimises one pipeline at a time; a
+process serving heavy traffic runs many of them at once, and two naive
+concurrent streams each assume sole ownership of device memory (their
+donation rings independently sized to the whole budget) while their
+dispatches serialise on ad-hoc locks.  This module is the scheduler
+that lets N tenants share one process and one device mesh safely:
+
+* a **device-memory arbiter** (:class:`DeviceArbiter`) generalises the
+  streaming executor's donation ring + in-flight window into ONE
+  process-wide bytes-weighted budget: streamed slab uploads
+  (``bolt_tpu.stream`` acquires per slab, in slab order, releasing on
+  confirmed retirement) and terminal dispatches (the worker leases a
+  pipeline's estimated working set) draw permits from it, so N tenants
+  split HBM instead of each assuming all of it.  Waiters are queued
+  per tenant and granted **round-robin across tenants, FIFO within a
+  tenant** — fair share across tenants, in-order budget delivery per
+  stream (the executor's ``_Reseq`` fencing keeps each tenant's fold
+  bit-exact regardless of grant interleaving);
+* a **fair-share scheduler** (:class:`Server`): ``submit(pipeline,
+  tenant=...)`` returns a :class:`Future`; worker threads pop jobs
+  round-robin across per-tenant queues, so one chatty tenant cannot
+  starve the rest, while each tenant's own jobs run in submission
+  order;
+* **cross-tenant coalescing of identical executables**: the engine
+  cache is keyed on program structure, and ``engine.get`` /
+  ``_Dispatch`` now coalesce concurrent identical builds/compiles
+  (``coalesced_builds`` / ``coalesced_compiles`` counters), so N
+  tenants running the same pipeline shape trace and compile it ONCE —
+  provided they share the stage callables (hoist user functions to
+  module level, as every bench does; two bytecode-identical lambdas
+  are distinct cache keys);
+* **admission control with backpressure**: the queue is bounded
+  (``queue_limit``); ``policy="queue"`` blocks the submitter until
+  room frees (backpressure), ``policy="reject"`` raises
+  :class:`AdmissionError` immediately.  A pipeline whose estimated
+  working set exceeds the WHOLE budget can never run and is rejected
+  at submit time — the ``BLT010`` diagnostic
+  (``bolt_tpu.analysis.check`` emits it whenever a serving arbiter is
+  active, so ``explain()`` shows the refusal before anything is
+  queued).
+
+Observability: queue depth (+ high-water), per-job queue-wait and run
+seconds (totals per tenant, a log2 histogram overall), arbiter
+in-use/high-water bytes and wait counts all land in
+``bolt_tpu.obs.registry()`` under ``serve.*`` names; every job runs
+inside an ``engine.tenant(<name>)`` scope, so the engine counters —
+transfer bytes, compiles, dispatches — are ALSO tallied per tenant
+(``engine.tenant_counters(name)``), streamed ingest traffic included
+(the executor forwards the tag into its uploader pool).
+
+The blessed entry points::
+
+    with bolt_tpu.serve.serving(workers=4, budget_bytes=2 << 30) as sv:
+        futs = [sv.submit(make_pipeline(), tenant=t) for t in tenants]
+        outs = [f.result() for f in futs]
+
+or the module-level :func:`submit`, which lazily starts a default
+server (env-tunable: ``BOLT_SERVE_WORKERS`` / ``BOLT_SERVE_BUDGET``
+/ ``BOLT_SERVE_QUEUE_LIMIT``).  Lint rule BLT108 keeps this module and
+``stream.py`` the ONLY homes of raw thread construction in the
+package — every other concurrency need routes through one of them.
+"""
+
+import contextlib
+import os
+import threading
+from collections import OrderedDict, deque
+
+from bolt_tpu import engine as _engine
+from bolt_tpu.obs import metrics as _metrics
+from bolt_tpu.obs import trace as _obs
+from bolt_tpu.obs.trace import clock as _clock
+
+# ---------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------
+
+# process-wide HBM budget for the arbiter.  The default is deliberately
+# conservative (1 GB): serving N tenants means N rings + N in-flight
+# windows, and the budget is what keeps their SUM bounded; size it to
+# the device's usable HBM in production.
+_DEF_BUDGET = int(os.environ.get("BOLT_SERVE_BUDGET", str(1 << 30)))
+_DEF_WORKERS = max(1, int(os.environ.get("BOLT_SERVE_WORKERS", "4")))
+_DEF_QUEUE = max(1, int(os.environ.get("BOLT_SERVE_QUEUE_LIMIT", "64")))
+
+# per-tenant + global serve counter schema (obs registry groups
+# "serve" and "serve/<tenant>")
+_SCHEMA = {
+    "submitted": 0,            # jobs accepted into the queue
+    "rejected": 0,             # jobs refused (queue full / BLT010)
+    "completed": 0,            # jobs finished successfully
+    "failed": 0,               # jobs whose pipeline raised
+    "queue_wait_seconds": 0.0,  # total submit->start wait
+    "run_seconds": 0.0,        # total start->finish execution time
+}
+
+
+class AdmissionError(RuntimeError):
+    """A submission the server refused: the bounded queue is full under
+    ``policy="reject"``, or the pipeline's estimated device working set
+    exceeds the arbiter's whole budget (BLT010 — it could never run)."""
+
+
+# ---------------------------------------------------------------------
+# the device-memory arbiter
+# ---------------------------------------------------------------------
+
+class _Ticket:
+    __slots__ = ("nbytes", "granted", "skipped")
+
+    def __init__(self, nbytes):
+        self.nbytes = nbytes
+        self.granted = False
+        self.skipped = 0      # grants that bypassed this waiting head
+
+
+# grants that may bypass a waiting head ticket before the arbiter stops
+# feeding newer requests and drains toward it (the anti-starvation
+# barrier: without it, sustained small-slab traffic keeps _used high
+# forever and a large request never sees the budget it needs)
+_STARVE_LIMIT = 64
+
+
+class DeviceArbiter:
+    """Process-wide bytes-weighted device-memory budget.
+
+    ``acquire(nbytes, tenant)`` blocks until the bytes fit (or the
+    caller's ``stop`` event fires); ``release(nbytes)`` returns them.
+    Waiters queue FIFO per tenant and are granted round-robin ACROSS
+    tenants — the fair-share rule — with one escape: a request larger
+    than the whole budget is granted when nothing else holds bytes
+    (it runs alone), so an oversized slab degrades to serial execution
+    instead of hanging forever.
+
+    Prefer :meth:`lease` over raw acquire/release: a
+    :class:`ArbiterLease` tracks its own outstanding bytes and
+    ``close()`` returns whatever an aborted run still held.
+    """
+
+    def __init__(self, budget_bytes):
+        self.budget = int(budget_bytes)
+        if self.budget <= 0:
+            raise ValueError("arbiter budget must be positive, got %d"
+                             % self.budget)
+        self._cond = threading.Condition()
+        self._used = 0
+        self._queues = OrderedDict()       # tenant -> deque[_Ticket]
+        self._ring = deque()               # tenants with waiters (RR)
+        reg = _metrics.registry()
+        self._g_used = reg.gauge("serve.arbiter_in_use_bytes")
+        self._g_hw = reg.gauge("serve.arbiter_in_use_high_water")
+        self._c_waits = reg.counter("serve.arbiter_waits")
+        self._c_wait_s = reg.counter("serve.arbiter_wait_seconds", 0.0)
+
+    # -- accounting ----------------------------------------------------
+
+    def in_use(self):
+        with self._cond:
+            return self._used
+
+    def waiting(self):
+        """Queued (ungranted) requests across all tenants."""
+        with self._cond:
+            return sum(len(q) for q in self._queues.values())
+
+    # -- the grant rule ------------------------------------------------
+
+    def _fits(self, nbytes):
+        return self._used + nbytes <= self.budget or self._used == 0
+
+    def _grant_locked(self):
+        """Round-robin across tenants with waiters, FIFO within each:
+        grant every head ticket that fits, looping until a full cycle
+        grants nothing.  The rotation pointer advances only PAST a
+        grantee (a full cycle of failed probes returns the ring to its
+        origin), so the next grant always starts at the tenant after
+        the last one served — fair share, not scan-order luck."""
+        made = True
+        while made and self._ring:
+            made = False
+            # anti-starvation barrier: a head ticket bypassed by more
+            # than _STARVE_LIMIT grants becomes the ONLY grantable one —
+            # releases then drain _used toward it instead of feeding an
+            # endless stream of newer, smaller requests (without this, a
+            # near-budget request under sustained small-slab traffic
+            # would wait forever; with it, starvation is bounded)
+            starved = None
+            for q in self._queues.values():
+                tk = q[0] if q else None
+                if tk is not None and tk.skipped >= _STARVE_LIMIT and \
+                        (starved is None or tk.skipped > starved.skipped):
+                    starved = tk
+            for _ in range(len(self._ring)):
+                t = self._ring[0]
+                q = self._queues.get(t)
+                tk = q[0] if q else None
+                if tk is not None and self._fits(tk.nbytes) \
+                        and (starved is None or tk is starved):
+                    q.popleft()
+                    tk.granted = True
+                    self._used += tk.nbytes
+                    made = True
+                    for q2 in self._queues.values():  # age bypassed heads
+                        if q2 and q2[0] is not tk:
+                            q2[0].skipped += 1
+                    self._ring.rotate(-1)   # next cycle starts AFTER t
+                    break                   # rescan from the new head
+                self._ring.rotate(-1)
+        for t in [t for t, q in self._queues.items() if not q]:
+            del self._queues[t]
+            try:
+                self._ring.remove(t)
+            except ValueError:
+                pass
+        self._g_used.set(self._used)
+        self._g_hw.high_water(self._used)
+        self._cond.notify_all()
+
+    # -- the public doors ----------------------------------------------
+
+    def acquire(self, nbytes, tenant="default", stop=None):
+        """Block until ``nbytes`` fit in the budget (True), or until
+        ``stop`` is set (False — nothing was acquired)."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return True
+        tk = _Ticket(nbytes)
+        t0 = _clock()
+        with self._cond:
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+                self._ring.append(tenant)
+            q.append(tk)
+            self._grant_locked()
+            waited = not tk.granted
+            while not tk.granted:
+                if stop is not None and stop.is_set():
+                    # withdraw (grants happen under this lock, so an
+                    # ungranted ticket is still safely in its queue)
+                    q.remove(tk)
+                    self._grant_locked()   # a later head may now fit
+                    return False
+                self._cond.wait(0.05)
+        if waited:
+            self._c_waits.inc()
+            self._c_wait_s.inc(_clock() - t0)
+        return True
+
+    def release(self, nbytes):
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        with self._cond:
+            self._used = max(0, self._used - nbytes)
+            self._grant_locked()
+
+    def lease(self, tenant="default"):
+        return ArbiterLease(self, tenant)
+
+
+class ArbiterLease:
+    """One run's handle on the arbiter: tracks outstanding bytes so an
+    abort path can return EVERYTHING it still holds with one
+    :meth:`close` (idempotent; release of bytes never acquired is
+    clamped to the outstanding balance)."""
+
+    __slots__ = ("arbiter", "tenant", "_lock", "_out")
+
+    def __init__(self, arbiter, tenant):
+        self.arbiter = arbiter
+        self.tenant = tenant
+        self._lock = threading.Lock()
+        self._out = 0
+
+    def outstanding(self):
+        with self._lock:
+            return self._out
+
+    def acquire(self, nbytes, stop=None):
+        ok = self.arbiter.acquire(nbytes, self.tenant, stop=stop)
+        if ok:
+            with self._lock:
+                self._out += int(nbytes)
+        return ok
+
+    def release(self, nbytes):
+        with self._lock:
+            n = min(int(nbytes), self._out)
+            self._out -= n
+        if n:
+            self.arbiter.release(n)
+
+    def close(self):
+        with self._lock:
+            n = self._out
+            self._out = 0
+        if n:
+            self.arbiter.release(n)
+
+
+# ---------------------------------------------------------------------
+# futures
+# ---------------------------------------------------------------------
+
+class Future:
+    """The handle :meth:`Server.submit` returns.  ``result(timeout)``
+    blocks for the pipeline's value (re-raising its exception);
+    ``wait_seconds`` / ``run_seconds`` expose the job's queue and
+    execution time once known."""
+
+    __slots__ = ("tenant", "_event", "_result", "_exc", "submitted_s",
+                 "started_s", "finished_s")
+
+    def __init__(self, tenant):
+        self.tenant = tenant
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+        self.submitted_s = _clock()
+        self.started_s = None
+        self.finished_s = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def _finish(self, result=None, exc=None):
+        self._result = result
+        self._exc = exc
+        self.finished_s = _clock()
+        self._event.set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve job still pending after %ss"
+                               % timeout)
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve job still pending after %ss"
+                               % timeout)
+        return self._exc
+
+    @property
+    def wait_seconds(self):
+        """Submit → start queue wait (None until started)."""
+        if self.started_s is None:
+            return None
+        return self.started_s - self.submitted_s
+
+    @property
+    def run_seconds(self):
+        """Start → finish execution time (None until finished)."""
+        if self.started_s is None or self.finished_s is None:
+            return None
+        return self.finished_s - self.started_s
+
+    def __repr__(self):
+        state = ("done" if self.done()
+                 else "running" if self.started_s is not None
+                 else "queued")
+        return "<serve.Future tenant=%r %s>" % (self.tenant, state)
+
+
+# ---------------------------------------------------------------------
+# the scheduler
+# ---------------------------------------------------------------------
+
+def _normalise(pipeline):
+    """One uniform job shape: a zero-arg callable returning the result.
+
+    Accepted inputs: a zero-arg callable (called as-is); a bolt array
+    carrying lazy state (a pending stat handle, a deferred chain, a
+    streaming source) — resolved via ``.cache()`` and returned; any
+    other object is rejected eagerly (a silent pass-through would hide
+    a caller bug until ``result()``)."""
+    if callable(pipeline) and not hasattr(pipeline, "cache"):
+        return pipeline, None
+    cache = getattr(pipeline, "cache", None)
+    if callable(cache):
+        return (lambda: pipeline.cache()), pipeline
+    raise TypeError(
+        "serve.submit needs a zero-arg callable or a bolt array "
+        "pipeline (got %r)" % type(pipeline).__name__)
+
+
+def _estimate(arr):
+    """The MINIMUM device working set of a bolt-array pipeline (the
+    BLT010 admission floor: one slab for streams — the arbiter degrades
+    the ring; base + result for in-memory pipelines).  None when
+    nothing could be estimated (callables, local arrays)."""
+    try:
+        from bolt_tpu.analysis import admission_floor_bytes
+        return admission_floor_bytes(arr)
+    except Exception:
+        return None
+
+
+class Server:
+    """The multi-tenant scheduler: per-tenant FIFO queues drained
+    round-robin by ``workers`` threads, every job leased against the
+    shared :class:`DeviceArbiter` and executed inside its tenant's
+    ``engine.tenant`` counter scope.  See the module docstring for the
+    full contract."""
+
+    def __init__(self, workers=None, budget_bytes=None, queue_limit=None,
+                 policy="queue"):
+        if policy not in ("queue", "reject"):
+            raise ValueError("policy must be 'queue' or 'reject', got %r"
+                             % (policy,))
+        self.workers = int(workers if workers is not None
+                           else _DEF_WORKERS)
+        self.queue_limit = int(queue_limit if queue_limit is not None
+                               else _DEF_QUEUE)
+        self.policy = policy
+        self.arbiter = DeviceArbiter(budget_bytes if budget_bytes
+                                     is not None else _DEF_BUDGET)
+        self._cond = threading.Condition()
+        self._queues = OrderedDict()       # tenant -> deque of jobs
+        self._ring = deque()               # tenants with queued jobs
+        self._depth = 0
+        self._closing = False
+        self._stop = threading.Event()     # workers exit once drained
+        self._cancel = threading.Event()   # close(wait=False) ONLY: a
+        #                                    leased job's arbiter wait
+        #                                    must survive a clean drain
+        reg = _metrics.registry()
+        self._counters = reg.group("serve", _SCHEMA)
+        self._g_depth = reg.gauge("serve.queue_depth")
+        self._g_depth_hw = reg.gauge("serve.queue_depth_high_water")
+        self._h_wait = reg.histogram("serve.queue_wait_seconds.hist")
+        self._threads = [
+            threading.Thread(target=self._worker,
+                             name="bolt-serve-worker-%d" % i, daemon=True)
+            for i in range(self.workers)]
+        for th in self._threads:
+            th.start()
+
+    # -- submission ----------------------------------------------------
+
+    def _tenant_counters(self, tenant):
+        return _metrics.registry().group("serve/%s" % tenant, _SCHEMA)
+
+    def _reject(self, tenant, why):
+        self._counters.add("rejected")
+        self._tenant_counters(tenant).add("rejected")
+        raise AdmissionError(why)
+
+    def submit(self, pipeline, tenant="default"):
+        """Queue ``pipeline`` for tenant ``tenant``; returns a
+        :class:`Future`.  Raises :class:`AdmissionError` when the
+        pipeline can never fit the arbiter budget (BLT010), or when the
+        queue is full under ``policy="reject"``; under
+        ``policy="queue"`` a full queue BLOCKS the submitter until a
+        worker frees a slot (backpressure, not unbounded memory)."""
+        if self._closing:
+            raise RuntimeError("serve.Server is closed")
+        tenant = str(tenant)
+        job, arr = _normalise(pipeline)
+        est = _estimate(arr) if arr is not None else None
+        if est is not None and est > self.arbiter.budget:
+            # BLT010: could NEVER run — admitting it would wedge a
+            # worker forever (analysis.check emits the same finding)
+            self._reject(tenant,
+                         "pipeline needs ~%d bytes of device memory but "
+                         "the serving budget is %d bytes (BLT010); "
+                         "shrink the slabs/operand or raise "
+                         "budget_bytes" % (est, self.arbiter.budget))
+        fut = Future(tenant)
+        # streaming pipelines lease per slab INSIDE the executor — an
+        # upfront worker lease on top would double-charge the budget
+        # (and deadlock it when budget ~ one slab).  A stream hides in
+        # two shapes: a raw stream-backed array, or a pending-stat
+        # handle whose GROUP folds a stream source.
+        streaming = False
+        if arr is not None:
+            if getattr(arr, "_stream", None) is not None:
+                streaming = True
+            else:
+                h = getattr(arr, "_spending", None)
+                if h is not None and h.group.kind == "stream":
+                    streaming = True
+        admitted = False
+        with self._cond:
+            while self._depth >= self.queue_limit and not self._closing \
+                    and self.policy != "reject":
+                self._cond.wait(0.05)     # backpressure: block submitter
+            if self._closing:
+                raise RuntimeError("serve.Server is closed")
+            if self._depth < self.queue_limit:
+                q = self._queues.get(tenant)
+                if q is None:
+                    q = self._queues[tenant] = deque()
+                    self._ring.append(tenant)
+                # streaming pipelines lease per slab inside the
+                # executor; in-memory pipelines lease their estimated
+                # working set around the dispatch
+                q.append((fut, job, None if streaming else est))
+                self._depth += 1
+                self._g_depth.set(self._depth)
+                self._g_depth_hw.high_water(self._depth)
+                self._cond.notify_all()
+                admitted = True
+        if not admitted:
+            self._reject(tenant,
+                         "admission queue is full (%d queued, limit %d, "
+                         "policy='reject')" % (self.queue_limit,
+                                               self.queue_limit))
+        self._counters.add("submitted")
+        self._tenant_counters(tenant).add("submitted")
+        return fut
+
+    # -- the worker loop -----------------------------------------------
+
+    def _pop(self):
+        """Next job, round-robin across tenants (FIFO within one); None
+        once the server is draining and every queue is empty."""
+        with self._cond:
+            while True:
+                for _ in range(len(self._ring)):
+                    t = self._ring[0]
+                    self._ring.rotate(-1)
+                    q = self._queues.get(t)
+                    if q:
+                        item = q.popleft()
+                        if not q:
+                            del self._queues[t]
+                            self._ring.remove(t)
+                        self._depth -= 1
+                        self._g_depth.set(self._depth)
+                        self._cond.notify_all()
+                        return t, item
+                if self._stop.is_set():
+                    return None
+                self._cond.wait(0.05)
+
+    def _worker(self):
+        while True:
+            got = self._pop()
+            if got is None:
+                return
+            tenant, (fut, job, est) = got
+            fut.started_s = _clock()
+            wait = fut.started_s - fut.submitted_s
+            self._counters.add("queue_wait_seconds", wait)
+            self._tenant_counters(tenant).add("queue_wait_seconds", wait)
+            self._h_wait.observe(wait)
+            sp = _obs.begin("serve.run", tenant=tenant,
+                            queued_s=round(wait, 6))
+            lease = self.arbiter.lease(tenant) if est else None
+            try:
+                with _engine.tenant(tenant):
+                    # stop on CANCEL only: a close(wait=True) drain must
+                    # let queued leased jobs wait out the arbiter and run
+                    if lease is not None and not lease.acquire(
+                            est, stop=self._cancel):
+                        raise RuntimeError(
+                            "server cancelled before the job's working "
+                            "set (%d bytes) was granted" % est)
+                    out = job()
+                fut._finish(result=out)
+                key = "completed"
+            except BaseException as exc:    # noqa: BLE001 — delivered
+                fut._finish(exc=exc)        # through Future.result()
+                key = "failed"
+            finally:
+                if lease is not None:
+                    lease.close()
+                _obs.end(sp)
+            run_s = fut.finished_s - fut.started_s
+            self._counters.update(**{key: 1, "run_seconds": run_s})
+            self._tenant_counters(tenant).update(
+                **{key: 1, "run_seconds": run_s})
+
+    # -- lifecycle / introspection -------------------------------------
+
+    def queue_depth(self):
+        with self._cond:
+            return self._depth
+
+    def stats(self):
+        """One consistent-ish status dict: global serve counters, queue
+        depth, arbiter state, and a per-tenant breakdown (serve counters
+        + that tenant's scoped ENGINE counters — transfer bytes,
+        dispatches, compiles)."""
+        reg = _metrics.registry()
+        out = {"queue_depth": self.queue_depth(),
+               "queue_depth_high_water": self._g_depth_hw.value,
+               "arbiter": {"budget_bytes": self.arbiter.budget,
+                           "in_use_bytes": self.arbiter.in_use(),
+                           "in_use_high_water": reg.gauge(
+                               "serve.arbiter_in_use_high_water").value,
+                           "waits": reg.counter(
+                               "serve.arbiter_waits").value},
+               "totals": self._counters.snapshot(),
+               "tenants": {}}
+        for name in reg.names():
+            if name.startswith("serve/"):
+                t = name.split("/", 1)[1]
+                entry = dict(reg.get(name).snapshot())
+                eng = _engine.tenant_counters(t)
+                entry["transfer_bytes"] = eng["transfer_bytes"]
+                entry["dispatches"] = eng["dispatches"]
+                entry["aot_compiles"] = eng["aot_compiles"]
+                out["tenants"][t] = entry
+        return out
+
+    def close(self, wait=True):
+        """Stop the server.  ``wait=True`` drains queued jobs first and
+        joins the workers; ``wait=False`` fails every queued job with a
+        RuntimeError and returns once workers exit their current job."""
+        with self._cond:
+            self._closing = True
+            if not wait:
+                self._cancel.set()
+                while self._queues:
+                    _, q = self._queues.popitem()
+                    for fut, _, _ in q:
+                        fut._finish(exc=RuntimeError(
+                            "serve.Server closed before this job ran"))
+                self._ring.clear()
+                self._depth = 0
+                self._g_depth.set(0)
+            self._stop.set()
+            self._cond.notify_all()
+        for th in self._threads:
+            th.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(wait=exc == (None, None, None))
+
+
+# ---------------------------------------------------------------------
+# the module-level (default-server) doors
+# ---------------------------------------------------------------------
+
+_ACTIVE = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def start(workers=None, budget_bytes=None, queue_limit=None,
+          policy="queue"):
+    """Start and install THE process server (at most one may be active
+    — the arbiter is only a global budget if there is one of it).
+    Returns the :class:`Server`."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            raise RuntimeError(
+                "a serve.Server is already active; stop() it first "
+                "(the device-memory budget must have one owner)")
+        _ACTIVE = Server(workers=workers, budget_bytes=budget_bytes,
+                         queue_limit=queue_limit, policy=policy)
+        return _ACTIVE
+
+
+def stop(wait=True):
+    """Stop and uninstall the active server (no-op when none is)."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        sv, _ACTIVE = _ACTIVE, None
+    if sv is not None:
+        sv.close(wait=wait)
+
+
+def active():
+    """The installed :class:`Server`, or None."""
+    return _ACTIVE
+
+
+def device_arbiter():
+    """The active server's :class:`DeviceArbiter` (None when no server
+    is running) — the door ``bolt_tpu.stream`` checks per run."""
+    sv = _ACTIVE
+    return sv.arbiter if sv is not None else None
+
+
+def submit(pipeline, tenant="default"):
+    """Submit through the active server, lazily starting the default
+    one (env-tuned) when none is running."""
+    global _ACTIVE
+    sv = _ACTIVE
+    if sv is None:
+        with _ACTIVE_LOCK:
+            if _ACTIVE is None:
+                _ACTIVE = Server()
+            sv = _ACTIVE
+    return sv.submit(pipeline, tenant=tenant)
+
+
+@contextlib.contextmanager
+def serving(workers=None, budget_bytes=None, queue_limit=None,
+            policy="queue"):
+    """Scoped server lifetime::
+
+        with bolt_tpu.serve.serving(workers=4) as sv:
+            fut = sv.submit(pipeline, tenant="a")
+            out = fut.result()
+
+    Drains and stops on clean exit; cancels queued jobs when the body
+    raised."""
+    sv = start(workers=workers, budget_bytes=budget_bytes,
+               queue_limit=queue_limit, policy=policy)
+    try:
+        yield sv
+    except BaseException:
+        stop(wait=False)
+        raise
+    else:
+        stop(wait=True)

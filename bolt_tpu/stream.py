@@ -68,6 +68,7 @@ the dead thread instead of blocking forever.
 import contextlib
 import os
 import queue
+import sys
 import threading
 import warnings
 from collections import deque
@@ -101,6 +102,22 @@ _DEPTH = max(1, int(os.environ.get("BOLT_STREAM_DEPTH", "2")))
 _UPLOADERS = max(0, int(os.environ.get("BOLT_STREAM_UPLOAD_THREADS",
                                        "0")))
 
+# the prefetch()/uploaders() SCOPES are thread-local (like
+# engine.donation and bolt.precision): under the multi-tenant serving
+# layer (bolt_tpu.serve) concurrent streams run on different threads,
+# and one tenant's `with uploaders(8)` must not inflate a neighbour's
+# pool mid-run.  set_prefetch_depth/set_upload_threads change the
+# PROCESS-WIDE default the scopes override.
+_SCOPE_TLS = threading.local()
+
+
+def _scope_stack(name):
+    st = getattr(_SCOPE_TLS, name, None)
+    if st is None:
+        st = []
+        setattr(_SCOPE_TLS, name, st)
+    return st
+
 # default slab budget when the caller gives no explicit record count:
 # big enough to amortise per-dispatch overhead, small enough that
 # depth+1 slabs stay far below any device's HBM
@@ -108,12 +125,18 @@ _SLAB_BYTES = int(os.environ.get("BOLT_STREAM_SLAB_BYTES", str(64 << 20)))
 
 
 def prefetch_depth():
-    """The active prefetch (ring) depth."""
+    """The active prefetch (ring) depth for the CALLING THREAD: the
+    innermost :func:`prefetch` scope on this thread, else the
+    process-wide default."""
+    st = _scope_stack("depth")
+    if st:
+        return st[-1]
     return _DEPTH
 
 
 def set_prefetch_depth(k):
-    """Set the process-wide prefetch depth (ring size), >= 1."""
+    """Set the process-wide DEFAULT prefetch depth (ring size), >= 1;
+    per-thread :func:`prefetch` scopes override it."""
     global _DEPTH
     _DEPTH = max(1, int(k))
 
@@ -124,24 +147,31 @@ def prefetch(depth):
 
         with bolt_tpu.stream.prefetch(4):
             big.chunk().map(f).mean()
-    """
-    global _DEPTH
-    old = _DEPTH
-    _DEPTH = max(1, int(depth))
+
+    The scope is THREAD-LOCAL: a concurrent stream on another thread
+    (another serve tenant) keeps its own value — one tenant's deep ring
+    must not silently multiply a neighbour's device-memory footprint."""
+    st = _scope_stack("depth")
+    st.append(max(1, int(depth)))
     try:
         yield
     finally:
-        _DEPTH = old
+        st.pop()
 
 
 def upload_threads():
-    """The configured uploader-pool size (0 = auto: resolved per run as
-    ``min(mesh devices, 4)``)."""
+    """The configured uploader-pool size for the calling thread
+    (innermost :func:`uploaders` scope, else the process default;
+    0 = auto: resolved per run as ``min(mesh devices, 4)``)."""
+    st = _scope_stack("uploaders")
+    if st:
+        return st[-1]
     return _UPLOADERS
 
 
 def set_upload_threads(n):
-    """Set the process-wide uploader-pool size (0 restores auto)."""
+    """Set the process-wide DEFAULT uploader-pool size (0 restores
+    auto); per-thread :func:`uploaders` scopes override it."""
     global _UPLOADERS
     _UPLOADERS = max(0, int(n))
 
@@ -153,25 +183,28 @@ def uploaders(n):
 
         with bolt_tpu.stream.uploaders(8):
             src.map(f).sum()
-    """
-    global _UPLOADERS
-    old = _UPLOADERS
-    _UPLOADERS = max(0, int(n))
+
+    THREAD-LOCAL, like :func:`prefetch` — concurrent streams on other
+    threads resolve their own scopes (regression-locked in
+    tests/test_stream.py)."""
+    st = _scope_stack("uploaders")
+    st.append(max(0, int(n)))
     try:
         yield
     finally:
-        _UPLOADERS = old
+        st.pop()
 
 
 def pool_size(source):
     """The uploader-pool size a run over ``source`` will use: the
-    configured count (scope/env), else ``min(mesh devices, 4)``;
-    sequential ``fromiter`` sources always use ONE prefetch thread
-    (their iterator cannot be consumed concurrently)."""
+    calling thread's configured count (scope/env), else ``min(mesh
+    devices, 4)``; sequential ``fromiter`` sources always use ONE
+    prefetch thread (their iterator cannot be consumed concurrently)."""
     if source.kind != "callback":
         return 1
-    if _UPLOADERS >= 1:
-        return _UPLOADERS
+    n = upload_threads()
+    if n >= 1:
+        return n
     ndev = int(source.mesh.devices.size) if source.mesh is not None else 1
     return min(max(ndev, 1), 4)
 
@@ -180,6 +213,23 @@ def _cached_jit(key, builder):
     """Engine-routed executable dispatch (same contract as the op
     modules'; ``bolt_tpu.profile.instrument`` patches this name)."""
     return _engine.get(key, builder)
+
+
+def _tenant_lease():
+    """A device-memory lease from the ACTIVE serving arbiter
+    (``bolt_tpu.serve``), attributed to the calling thread's tenant —
+    or ``None`` when no serving layer is running.  Consulted through
+    ``sys.modules`` so merely streaming never imports (or starts) the
+    serving layer; with a lease in hand the executor's slab uploads
+    charge the process-wide bytes budget instead of assuming sole
+    ownership of device memory."""
+    sv = sys.modules.get("bolt_tpu.serve")
+    if sv is None:
+        return None
+    arb = sv.device_arbiter()
+    if arb is None:
+        return None
+    return arb.lease(_engine.current_tenant() or "default")
 
 
 # ---------------------------------------------------------------------
@@ -915,7 +965,8 @@ class _Reseq:
             "— e.g. interpreter teardown); the stream cannot complete"
             % (", ".join(repr(t.name) for t in dead), self._next))
 
-    def next(self, threads, workers=None, timeout=0.1, stall_limit=300):
+    def next(self, threads, workers=None, timeout=0.1, stall_limit=300,
+             idle=None):
         """The next ``(slab_i, item)`` in slab order, or ``None`` at
         end-of-stream.  Re-raises a recorded pool fault; polls with a
         timeout and liveness checks so pool threads that died WITHOUT
@@ -930,13 +981,20 @@ class _Reseq:
           workers alive but starved of jobs → raise after
           ``stall_limit`` polls with no new delivery (~30 s grace so a
           genuinely slow in-hand upload is not mistaken for the hang).
+
+        ``idle`` (when given) runs OUTSIDE the lock after each poll that
+        delivered nothing — the arbiter-backed runs' starvation valve:
+        the consumer confirms already-retired in-flight windows there,
+        releasing budget bytes the (possibly blocked) dispenser is
+        waiting on, so a budget smaller than one run's full ring
+        degrades to a shallower pipeline instead of a deadlock.
         """
         ingesters = threads if workers is None else workers
         lead = threads[0]
         stalls = 0
         seen = -1
-        with self._cond:
-            while True:
+        while True:
+            with self._cond:
                 if self._exc is not None:
                     raise self._exc
                 if self._next in self._slots:
@@ -957,6 +1015,8 @@ class _Reseq:
                     if stalls > stall_limit:
                         raise self._dead(threads)
                 self._cond.wait(timeout)
+            if idle is not None:
+                idle()
 
 
 def _acquire(sem, stop):
@@ -1011,6 +1071,15 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     split = source.split
     depth = prefetch_depth()
     nwork = pool_size(source)
+    # multi-tenant serving (bolt_tpu.serve): the run charges its slab
+    # bytes to the process-wide device-memory arbiter — the ring's local
+    # permit bound still applies, but N concurrent tenants now share one
+    # HBM budget instead of each assuming sole ownership.  The tenant
+    # tag rides into the pool threads so their transfer accounting lands
+    # in the submitting tenant's scoped counters.
+    tenant_tag = _engine.current_tenant()
+    lease = _tenant_lease()
+    rec_bytes = prod(source.shape[1:]) * source.dtype.itemsize
     # the donated ring: at most depth + pool-size slab buffers exist at
     # once (each worker holds one in hand, depth more may wait uploaded
     # or dispatched-unconfirmed).  A permit is acquired per dispensed
@@ -1049,11 +1118,17 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     def dispenser():
         """Callback sources: hand (slab_i, lo, hi) index jobs to the
         uploader pool in slab order; workers produce AND upload their
-        own slabs concurrently (random access makes that safe)."""
+        own slabs concurrently (random access makes that safe).  Ring
+        permits AND arbiter bytes are acquired HERE, in slab order —
+        per-stream in-order budget delivery, so a tenant's own slabs can
+        never deadlock each other by acquiring out of order."""
         try:
             i = 0
             for lo, hi in source.slab_ranges():
                 if not _acquire(permits, stop):
+                    return
+                if lease is not None and not lease.acquire(
+                        (hi - lo) * rec_bytes, stop=stop):
                     return
                 jobq.put((i, lo, hi))
                 i += 1
@@ -1066,63 +1141,71 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
 
     def worker(wid):
         try:
-            while True:
-                job = jobq.get()
-                if job is None or stop.is_set():
-                    return
-                i, lo, hi = job
-                _act_enter()
-                sp = _obs.begin("stream.ingest", parent=run_sp, slab=i,
-                                worker=wid)
-                t0 = _clock()
-                try:
-                    block = source.produce_slab(lo, hi)
-                    buf = _upload_slab(block, mesh, split)
-                    tsec = _clock() - t0
-                    if sp is not None:
-                        sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
-                finally:
-                    _obs.end(sp)
-                    _act_exit()
-                del block
-                rsq.put(i, (buf, tsec))
+            with _engine.tenant(tenant_tag):
+                while True:
+                    job = jobq.get()
+                    if job is None or stop.is_set():
+                        return
+                    i, lo, hi = job
+                    _act_enter()
+                    sp = _obs.begin("stream.ingest", parent=run_sp,
+                                    slab=i, worker=wid)
+                    t0 = _clock()
+                    try:
+                        block = source.produce_slab(lo, hi)
+                        buf = _upload_slab(block, mesh, split)
+                        tsec = _clock() - t0
+                        if sp is not None:
+                            sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
+                    finally:
+                        _obs.end(sp)
+                        _act_exit()
+                    del block
+                    rsq.put(i, (buf, tsec))
         except BaseException as exc:        # noqa: BLE001 — re-raised in
             rsq.fault(exc)                  # the consumer thread
 
     def prefetch():
         """Iterator sources: ONE produce+upload thread (the iterable is
         sequential; concurrent ``next()`` would corrupt it).  The ingest
-        span/time covers produce AND upload, like a worker's."""
+        span/time covers produce AND upload, like a worker's; arbiter
+        bytes are acquired between produce and upload (an iterator
+        slab's size is only known once the block is in hand)."""
         i = 0
         try:
-            it = source.slabs()
-            while True:
-                if stop.is_set():
-                    return
-                if not _acquire(permits, stop):
-                    return
-                _act_enter()
-                sp = _obs.begin("stream.ingest", parent=run_sp, slab=i)
-                t0 = _clock()
-                try:
+            with _engine.tenant(tenant_tag):
+                it = source.slabs()
+                while True:
+                    if stop.is_set():
+                        return
+                    if not _acquire(permits, stop):
+                        return
+                    _act_enter()
+                    sp = _obs.begin("stream.ingest", parent=run_sp,
+                                    slab=i)
+                    t0 = _clock()
                     try:
-                        lo, hi, block = next(it)
-                    except StopIteration:
-                        _obs.cancel(sp)     # probe saw end-of-source
-                        sp = None
-                        permits.release()   # unused hand-slot permit
-                        break
-                    buf = _upload_slab(block, mesh, split)
-                    tsec = _clock() - t0
-                    if sp is not None:
-                        sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
-                finally:
-                    _obs.end(sp)
-                    _act_exit()
-                del block
-                rsq.put(i, (buf, tsec))
-                i += 1
-            rsq.finish(i)
+                        try:
+                            lo, hi, block = next(it)
+                        except StopIteration:
+                            _obs.cancel(sp)   # probe saw end-of-source
+                            sp = None
+                            permits.release()  # unused hand-slot permit
+                            break
+                        if lease is not None and not lease.acquire(
+                                int(block.nbytes), stop=stop):
+                            return
+                        buf = _upload_slab(block, mesh, split)
+                        tsec = _clock() - t0
+                        if sp is not None:
+                            sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
+                    finally:
+                        _obs.end(sp)
+                        _act_exit()
+                    del block
+                    rsq.put(i, (buf, tsec))
+                    i += 1
+                rsq.finish(i)
         except BaseException as exc:        # noqa: BLE001
             rsq.fault(exc)
 
@@ -1149,10 +1232,52 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     nslabs = 0
     fold = None
     pend = None                 # even slab's partial awaiting its pair
-    pending_sync = deque()      # (slabs covered, partial) not confirmed
+    pend_bytes = 0              # that slab's arbiter bytes, still held
+    pending_sync = deque()      # (slabs covered, partial, bytes) not
+    #                             yet confirmed retired
     dispatched = 0
     confirmed = 0
     inflight_hw = 0
+
+    def _confirm_oldest():
+        """Sync the OLDEST unconfirmed pair partial (normally long
+        retired, ~free) and release its ring permits + arbiter bytes."""
+        nonlocal compute, confirmed
+        cov, ref, nb = pending_sync.popleft()
+        ssp = _obs.begin("stream.sync", slabs=cov)
+        t0 = _clock()
+        try:
+            jax.block_until_ready(ref)
+        finally:
+            _obs.end(ssp)
+        compute += _clock() - t0
+        confirmed += cov
+        permits.release(cov)
+        if lease is not None:
+            lease.release(nb)
+
+    def _starved():
+        """The arbiter-backed starvation valve (rsq.next's ``idle``):
+        with the feeder possibly blocked on budget bytes, confirm one
+        retired window per empty poll so its bytes recycle — a budget
+        smaller than the full ring then runs a shallower pipeline
+        instead of deadlocking.  Opens ONLY under real arbiter
+        contention (some acquire is queued — this run's blocked feeder
+        always is one): a feeder merely slow on I/O must not collapse
+        the bounded in-flight window into per-slab syncs.  The lone
+        unpaired partial is drained too: once its slab program retires,
+        the donated slab input is recycled and only a value-shaped
+        partial lives, so holding its slab-sized bytes would starve the
+        feeder forever on a one-slab-at-a-time budget."""
+        nonlocal pend_bytes
+        if lease.arbiter.waiting() == 0:
+            return                  # nobody needs bytes: keep the window
+        if pending_sync:
+            _confirm_oldest()
+        elif pend is not None and pend_bytes:
+            jax.block_until_ready(pend)
+            lease.release(pend_bytes)
+            pend_bytes = 0
 
     def _fold_push(part):
         nonlocal fold
@@ -1191,10 +1316,13 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     try:
         try:
             while True:
-                got = rsq.next(threads, workers=ingesters)
+                got = rsq.next(threads, workers=ingesters,
+                               idle=_starved if lease is not None
+                               else None)
                 if got is None:
                     break
                 slab_i, (buf, tsec) = got
+                slab_bytes = int(buf.nbytes)
                 ingest += tsec
                 t0 = _clock()
                 csp = _obs.begin("stream.compute", slab=slab_i)
@@ -1212,6 +1340,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                                                  buf.shape, ddof, rfunc,
                                                  comps=comps)
                             pend = prog(buf)
+                            pend_bytes = slab_bytes
                         else:
                             # level-0 fold fused into the slab dispatch
                             prog = _slab_program(source, terminal,
@@ -1220,7 +1349,9 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                             pairp = prog(buf, pend)
                             pend = None
                             _fold_push(pairp)
-                            pending_sync.append((2, pairp))
+                            pending_sync.append(
+                                (2, pairp, pend_bytes + slab_bytes))
+                            pend_bytes = 0
                     del buf, got           # the donated ring slot is free
                 finally:
                     _obs.end(csp)
@@ -1233,18 +1364,9 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                 # the window fills does the consumer block, and then on
                 # the OLDEST pair partial, dispatched ~window slabs ago
                 # and normally long retired (a ~free wait that releases
-                # its ring permits)
+                # its ring permits and arbiter bytes)
                 while dispatched - confirmed > window and pending_sync:
-                    cov, ref = pending_sync.popleft()
-                    ssp = _obs.begin("stream.sync", slabs=cov)
-                    t0 = _clock()
-                    try:
-                        jax.block_until_ready(ref)
-                    finally:
-                        _obs.end(ssp)
-                    compute += _clock() - t0
-                    confirmed += cov
-                    permits.release(cov)
+                    _confirm_oldest()
             if pend is not None:
                 # odd slab count: the unpaired tail partial joins the
                 # tree as its own leaf (deterministic — slab order only)
@@ -1299,6 +1421,8 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             return list(out)              # one jax array per member spec
         return BoltArrayTPU(out, 0, mesh)
     finally:
+        if lease is not None:
+            lease.close()       # return every outstanding budget byte
         _obs.end(run_sp)
 
 

@@ -37,6 +37,7 @@ sorted collect).
 """
 
 import sys
+import threading
 import warnings
 from collections import OrderedDict
 from functools import lru_cache
@@ -233,21 +234,29 @@ _GATHER_SLAB_BYTES = 256 << 20
 _LAST_GATHER_STATS = None
 
 
+_LRU_LOCK = threading.RLock()
+
+
 def _lru_get(cache, key, build):
     """Shared bounded-LRU policy for the aval/scalar-callable caches.
     NOTE: keys hold strong references to user callables, so a closure
     capturing a large array stays alive until its entry evicts — the
     values are the cheap part (executables/avals), the keys are what can
-    pin memory in pathological many-distinct-closures sessions."""
-    out = cache.get(key)
-    if out is None:
-        out = build()
-        cache[key] = out
-        if len(cache) > _JIT_CACHE_MAX:
-            cache.popitem(last=False)
-    else:
-        cache.move_to_end(key)
-    return out
+    pin memory in pathological many-distinct-closures sessions.
+    Locked: concurrent tenants (bolt_tpu.serve) walk these OrderedDicts
+    from many threads, and an unguarded move_to_end/popitem pair can
+    corrupt the linkage; ``build`` runs under the lock — it is
+    eval_shape-class host work, never an XLA compile."""
+    with _LRU_LOCK:
+        out = cache.get(key)
+        if out is None:
+            out = build()
+            cache[key] = out
+            if len(cache) > _JIT_CACHE_MAX:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+        return out
 
 
 def _cached_jit(key, builder):

@@ -39,8 +39,11 @@ the :func:`bolt_tpu._precision.accumulate` scope) is the opt-in fast
 path for the additive terminals of an in-memory fused group: values
 cast to bf16, accumulated in f32 (the accumulate-in-f32 contract; "f32"
 casts values to f32, which for f32 pipelines is exactly the default
-arithmetic).  The default (``None``) stays bit-exact; order statistics
-(min/max/any/all, the pair behind ptp) are always exact.
+arithmetic); ``accumulate="int8"`` is the integer twin — int8 values,
+int32 accumulator (accumulate-in-i32), integer additive terminals
+(sum/prod) only, exact for values in int8 range.  The default
+(``None``) stays bit-exact; order statistics (min/max/any/all, the
+pair behind ptp) are always exact.
 
 Streamed groups fold a tuple accumulator through the PR 5 pipeline
 (``stream.execute(terminal="multi")``): one ingest pass feeds every
@@ -83,8 +86,11 @@ _FPENDING_LAZY = ("sum", "prod", "any", "all", "mean", "var", "std")
 _STREAM_LAZY = ("sum", "mean", "var", "std", "min", "max", "ptp")
 
 # accumulate= applies to the additive reductions only; order statistics
-# are exact regardless
+# are exact regardless.  The float modes (bf16/f32) serve the whole
+# additive family; "int8" serves the INTEGER additive terminals — the
+# moment family is float-valued and ignores it
 _ADDITIVE = ("sum", "prod", "mean", "var", "std")
+_INT_ADDITIVE = ("sum", "prod")
 
 _OPS = {"mean": jnp.mean, "var": jnp.var, "std": jnp.std,
         "sum": jnp.sum, "max": jnp.max, "min": jnp.min,
@@ -381,7 +387,15 @@ def _stat_expr(mapped, name, axes, keepdims, ddof, mode):
     untouched."""
     op = _OPS[name]
     kwargs = {} if ddof is None else {"ddof": ddof}
-    if mode is not None and name in _ADDITIVE \
+    if mode == "int8":
+        # the integer twin of bf16: int8 values, int32 accumulator (the
+        # accumulate-in-i32 contract) — integer additive terminals of
+        # integer pipelines only; everything else stays exact
+        if name in _INT_ADDITIVE \
+                and jnp.issubdtype(mapped.dtype, jnp.integer):
+            return op(mapped.astype(jnp.int8), axis=axes,
+                      dtype=jnp.int32, keepdims=keepdims, **kwargs)
+    elif mode is not None and name in _ADDITIVE \
             and jnp.issubdtype(mapped.dtype, jnp.floating):
         if mode == "bf16":
             return op(mapped.astype(jnp.bfloat16), axis=axes,

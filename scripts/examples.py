@@ -210,6 +210,47 @@ def main():
     bolt.compute(h1, h2)
 
     # ------------------------------------------------------------------
+    section("8e. multi-tenant serving: N pipelines, one engine")
+    # N tenants share one process and one mesh: serve.submit queues each
+    # pipeline, worker threads drain the per-tenant queues round-robin,
+    # the device-memory arbiter keeps every stream inside ONE bytes
+    # budget, and identical pipeline shapes compile ONCE across tenants
+    from bolt_tpu import serve as _serve
+    xs = rs.randn(96, 16, 8).astype(np.float32)
+    double = lambda v: v * 2.0          # hoisted: tenants SHARE the
+    #                                     callable, so programs coalesce
+
+    def tenant_pipeline():
+        src = bolt.fromcallback(lambda idx: xs[idx], xs.shape, mesh,
+                                dtype=np.float32, chunks=24)
+        return src.map(double).sum()
+
+    expected = np.asarray(tenant_pipeline().toarray())  # single-tenant
+    with _serve.serving(workers=3, budget_bytes=64 << 20) as sv:
+        futs = [sv.submit(tenant_pipeline(), tenant=t)
+                for t in ("ana", "ben", "caro")]
+        for f in futs:                  # bit-identical per tenant
+            assert np.array_equal(np.asarray(f.result().toarray()),
+                                  expected)
+        st = sv.stats()
+    assert st["totals"]["completed"] >= 3
+    # per-tenant accounting: each tenant's scoped engine counters saw
+    # exactly its own ingest traffic
+    for t in ("ana", "ben", "caro"):
+        assert st["tenants"][t]["completed"] == 1
+        assert st["tenants"][t]["transfer_bytes"] >= xs.nbytes
+    # admission control: a pipeline that could NEVER fit the budget is
+    # rejected up front (the checker forecasts it as BLT010)
+    with _serve.serving(workers=1, budget_bytes=4096) as sv:
+        huge = bolt.fromcallback(lambda idx: xs[idx], xs.shape, mesh,
+                                 dtype=np.float32, chunks=96).sum()
+        try:
+            sv.submit(huge)
+            raise AssertionError("BLT010 pipeline was admitted")
+        except _serve.AdmissionError:
+            pass
+
+    # ------------------------------------------------------------------
     section("9. time-series pipeline: detrend -> zscore -> PCA")
     # per-pixel calcium-imaging-style workflow: remove each pixel's slow
     # drift, standardise, then find the dominant temporal components —

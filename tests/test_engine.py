@@ -342,3 +342,117 @@ def test_fused_filter_donates_sole_owned_base(mesh):
         assert np.allclose(np.asarray(out.toarray()), keep.sum(axis=0))
         with pytest.raises(RuntimeError, match="donated"):
             d.toarray()
+
+
+# ---------------------------------------------------------------------
+# cross-tenant coalescing (ISSUE 8): concurrent identical builds and
+# compiles collapse to ONE, counter-proven
+# ---------------------------------------------------------------------
+
+def test_concurrent_same_key_builds_coalesce(mesh):
+    import threading
+    import time as _time
+    calls = []
+
+    def builder():
+        calls.append(1)
+        _time.sleep(0.3)          # widen the race window: every other
+        #                           thread must arrive mid-build
+        return jax.jit(lambda t: t + 1)
+
+    key = ("test-coalesce-build", object())
+    c0 = engine.counters()
+    outs = []
+
+    def go():
+        outs.append(engine.get(key, builder))
+
+    threads = [threading.Thread(target=go, daemon=True) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    c1 = engine.counters()
+    assert len(calls) == 1                    # the builder ran ONCE
+    assert all(o is outs[0] for o in outs)    # everyone shares the entry
+    assert c1["misses"] - c0["misses"] == 1
+    # every lookup is accounted exactly once: 1 miss + 5 waits/hits
+    assert (c1["hits"] - c0["hits"]
+            + c1["coalesced_builds"] - c0["coalesced_builds"]) == 5
+
+
+def test_concurrent_same_signature_compiles_once(mesh):
+    import threading
+    key = ("test-coalesce-compile", object())
+    entry = engine.get(key, lambda: jax.jit(lambda t: t * 3))
+    x = jnp.arange(8.0)
+    c0 = engine.counters()
+    outs = []
+
+    def go():
+        outs.append(np.asarray(entry(x)))
+
+    threads = [threading.Thread(target=go, daemon=True) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    c1 = engine.counters()
+    # ONE aot compile for the signature, however many threads raced it
+    assert c1["aot_compiles"] - c0["aot_compiles"] == 1
+    assert all(np.array_equal(o, np.arange(8.0) * 3) for o in outs)
+
+
+def test_failed_build_wakes_waiters_who_rebuild(mesh):
+    import threading
+    import time as _time
+    state = {"n": 0}
+
+    def flaky_builder():
+        state["n"] += 1
+        if state["n"] == 1:
+            _time.sleep(0.2)
+            raise RuntimeError("first build fails")
+        return jax.jit(lambda t: t - 1)
+
+    key = ("test-coalesce-fail", object())
+    results = []
+
+    def go():
+        try:
+            results.append(engine.get(key, flaky_builder))
+        except RuntimeError as exc:
+            results.append(exc)
+
+    threads = [threading.Thread(target=go, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    # exactly one caller saw the failure; the waiters rebuilt and share
+    # a live entry (no thread hangs on the dead build's event)
+    errs = [r for r in results if isinstance(r, RuntimeError)]
+    live = [r for r in results if not isinstance(r, RuntimeError)]
+    assert len(errs) == 1 and len(live) == 2
+    assert live[0] is live[1]
+
+
+# ---------------------------------------------------------------------
+# per-tenant counter scoping (ISSUE 8)
+# ---------------------------------------------------------------------
+
+def test_tenant_scope_mirrors_engine_counters(mesh):
+    t0 = engine.tenant_counters("unit-tenant")
+    g0 = engine.counters()
+    with engine.tenant("unit-tenant"):
+        bolt.ones((8, 4), mesh).map(lambda v: v + 1).sum().toarray()
+    t1 = engine.tenant_counters("unit-tenant")
+    g1 = engine.counters()
+    assert t1["dispatches"] > t0["dispatches"]
+    # the tenant's tally is a SUBSET of the global one — never more
+    assert t1["dispatches"] - t0["dispatches"] \
+        <= g1["dispatches"] - g0["dispatches"]
+    # outside the scope, nothing mirrors
+    t2 = engine.tenant_counters("unit-tenant")
+    bolt.ones((8, 4), mesh).sum().toarray()
+    assert engine.tenant_counters("unit-tenant") == t2

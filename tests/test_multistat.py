@@ -584,3 +584,137 @@ def test_strict_gate_still_fires_at_call(mesh):
     with analysis.strict():
         with pytest.raises(analysis.PipelineError, match="BLT001"):
             bad.sum()
+
+
+# ---------------------------------------------------------------------
+# int8 accumulate (ISSUE 8 satellite): the integer twin of bf16 —
+# int8 values, int32 accumulator, integer additive terminals only
+# ---------------------------------------------------------------------
+
+def _xi(shape=(16, 6, 4)):
+    # int8-range values (the documented contract)
+    return ((np.arange(np.prod(shape)) % 101) - 50).astype(
+        np.int32).reshape(shape)
+
+
+def test_accumulate_int8_parity_locked_for_int_pipeline(mesh):
+    xi = _xi()
+    got = bolt.compute(bolt.array(xi, mesh).map(lambda v: v).sum(),
+                       accumulate="int8")
+    # the accumulate-in-i32 contract: int8 values, int32 accumulator —
+    # the numpy oracle with the same dtypes is EXACT parity
+    oracle = np.sum(xi.astype(np.int8), axis=0, dtype=np.int32)
+    out = np.asarray(got.toarray())
+    assert out.dtype == np.int32
+    assert np.array_equal(out, oracle)
+
+
+def test_accumulate_int8_fused_group_mixes_exact_order_stats(mesh):
+    xi = _xi()
+    m = bolt.array(xi, mesh).map(lambda v: v * 2)
+    s, mn, mx = bolt.compute(m.sum(), m.min(), m.max(),
+                             accumulate="int8")
+    vals = xi * 2               # doubled values may exceed int8: wrap,
+    #                             exactly like the cast contract says
+    oracle = np.sum(vals.astype(np.int8), axis=0, dtype=np.int32)
+    assert np.array_equal(np.asarray(s.toarray()), oracle)
+    # order statistics are ALWAYS exact, whatever the accumulate mode
+    assert np.array_equal(np.asarray(mn.toarray()), vals.min(axis=0))
+    assert np.array_equal(np.asarray(mx.toarray()), vals.max(axis=0))
+
+
+def test_accumulate_int8_leaves_float_pipelines_and_moments_exact(mesh):
+    x = _x(seed=21)
+    b = bolt.array(x, mesh).map(lambda v: v + 1)
+    s, v = bolt.compute(b.sum(), b.var(), accumulate="int8")
+    exact = bolt.array(x, mesh).map(lambda v: v + 1)
+    assert _bits(s.toarray(), bolt.compute(exact.sum()).toarray())
+    # an INT pipeline's moment terminals are float-valued: int8 must
+    # not touch them either
+    xi = _xi()
+    mean8 = bolt.compute(bolt.array(xi, mesh).map(lambda v: v).mean(),
+                         accumulate="int8")
+    assert _bits(mean8.toarray(),
+                 bolt.array(xi, mesh).mean().toarray())
+
+
+def test_accumulate_int8_scope_and_stream_rejection(mesh):
+    xi = _xi()
+    with _precision.accumulate("int8"):
+        got = bolt.compute(bolt.array(xi, mesh).map(lambda v: v).sum())
+    assert np.asarray(got.toarray()).dtype == np.int32
+    with pytest.raises(ValueError, match="in-memory"):
+        bolt.compute(_source(_intdata(), mesh, 4).sum(),
+                     accumulate="int8")
+
+
+# ---------------------------------------------------------------------
+# concurrency (ISSUE 8 satellite): try_join racing resolve, and
+# lock-consistent fused-counter snapshots
+# ---------------------------------------------------------------------
+
+def test_try_join_racing_resolve_never_strands_a_member(mesh):
+    import threading
+    x = _x((32, 4), seed=5)
+    oracle_sum = (x * 2).sum(axis=0)
+    oracle_var = (x * 2).var(axis=0)
+    for _ in range(20):                   # many interleavings
+        b = bolt.array(x, mesh).map(lambda v: v * 2)
+        first = b.sum()
+        got = {}
+
+        def reader():
+            got["sum"] = np.asarray(first.toarray())   # resolves group
+
+        def joiner():
+            h = b.var()                   # try_join may hit a group
+            got["var"] = np.asarray(h.toarray())       # mid-resolve
+
+        ts = [threading.Thread(target=reader, daemon=True),
+              threading.Thread(target=joiner, daemon=True)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        # whichever group each member landed in, both values are right
+        assert np.allclose(got["sum"], oracle_sum)
+        assert np.allclose(got["var"], oracle_var)
+
+
+def test_fused_counter_snapshots_are_lock_consistent(mesh):
+    import threading
+    x = _x((8, 3), seed=9)
+    c0 = engine.counters()
+    stopped = threading.Event()
+    bad = []
+
+    def snapshotter():
+        while not stopped.is_set():
+            c = engine.counters()
+            dg = c["fused_stat_groups"] - c0["fused_stat_groups"]
+            dt = c["fused_stat_terminals"] - c0["fused_stat_terminals"]
+            # every fused dispatch lands groups+terminals in ONE atomic
+            # update (2 terminals per group here): a snapshot must never
+            # interleave with a half-applied tally
+            if dt != 2 * dg:
+                bad.append((dg, dt))
+
+    def hammer():
+        for _ in range(10):
+            m = bolt.array(x, mesh).map(lambda v: v + 3)
+            bolt.compute(m.sum(), m.max())
+
+    snap = threading.Thread(target=snapshotter, daemon=True)
+    workers = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(3)]
+    snap.start()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(120)
+    stopped.set()
+    snap.join(10)
+    assert not bad
+    c1 = engine.counters()
+    assert c1["fused_stat_groups"] - c0["fused_stat_groups"] == 30
+    assert c1["fused_stat_terminals"] - c0["fused_stat_terminals"] == 60

@@ -305,6 +305,7 @@ _EXPECTED_ENGINE_KEYS = {
     "stream_overlap_seconds": True, "stream_prefetch_depth": False,
     "stream_upload_threads": False, "stream_inflight_high_water": False,
     "fused_stat_groups": False, "fused_stat_terminals": False,
+    "coalesced_builds": False, "coalesced_compiles": False,
 }
 
 

@@ -557,6 +557,44 @@ def test_lint_blt107_stray_sync_points():
 
 
 @pytest.mark.lint
+def test_lint_blt108_thread_construction_outside_blessed_homes():
+    # dotted form
+    src = ("import threading\n\n"
+           "def f():\n    return threading.Thread(target=print)\n")
+    assert [x.code for x in astlint.lint_source(
+        src, "bolt_tpu/ops/foo.py")] == ["BLT108"]
+    # from-import alias form
+    src2 = ("from threading import Thread\n\n"
+            "def f():\n    return Thread(target=print)\n")
+    assert [x.code for x in astlint.lint_source(
+        src2, "bolt_tpu/tpu/chunk.py")] == ["BLT108"]
+    # pool executors count as thread construction too
+    src3 = ("from concurrent.futures import ThreadPoolExecutor\n\n"
+            "def f():\n    return ThreadPoolExecutor(4)\n")
+    assert [x.code for x in astlint.lint_source(
+        src3, "bolt_tpu/checkpoint.py")] == ["BLT108"]
+    # renamed plain import must not dodge the rule
+    src4 = ("import threading as t\n\n"
+            "def f():\n    return t.Thread(target=print)\n")
+    assert [x.code for x in astlint.lint_source(
+        src4, "bolt_tpu/obs/trace.py")] == ["BLT108"]
+    # the two blessed concurrency homes pass
+    for home in ("bolt_tpu/stream.py", "bolt_tpu/serve.py"):
+        for s in (src, src2, src3):
+            assert astlint.lint_source(s, home) == []
+    # path anchoring: preserve.py does not inherit serve.py's pass
+    assert any(x.code == "BLT108" for x in astlint.lint_source(
+        src, "bolt_tpu/preserve.py"))
+    # locks/events/conditions are NOT construction — no finding
+    ok = ("import threading\n\n"
+          "L = threading.Lock()\nE = threading.Event()\n"
+          "C = threading.Condition()\nT = threading.local()\n")
+    assert astlint.lint_source(ok, "bolt_tpu/ops/foo.py") == []
+    # the repo itself holds at zero findings with the rule armed
+    assert astlint.lint_package() == []
+
+
+@pytest.mark.lint
 def test_lint_cli_check_mode_passes_on_repo():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "lint_bolt.py"),

@@ -761,3 +761,58 @@ def test_chunked_terminals_materialised(mesh):
                           np.asarray(b.reduce(np.maximum).toarray()))
     f = cv.filter(POSSUM)
     assert f.shape == b.filter(POSSUM).shape
+
+
+# ---------------------------------------------------------------------
+# scope thread-locality (ISSUE 8 regression): concurrent streams on
+# different threads must not leak uploaders()/prefetch() scope values
+# into each other — under the multi-tenant serving layer every tenant
+# runs on its own worker thread
+# ---------------------------------------------------------------------
+
+def test_uploaders_and_prefetch_scopes_are_thread_local(mesh):
+    default_uploaders = stream.upload_threads()
+    default_depth = stream.prefetch_depth()
+    barrier = threading.Barrier(2, timeout=10)
+    seen = {}
+    fail = []
+
+    def run(name, n, k):
+        try:
+            with stream.uploaders(n), stream.prefetch(k):
+                barrier.wait()          # both threads inside their scopes
+                seen[name] = (stream.upload_threads(),
+                              stream.prefetch_depth())
+                barrier.wait()          # hold the scopes open until both
+        except Exception as exc:        # sampled under the other's scope
+            fail.append(exc)
+
+    t1 = threading.Thread(target=run, args=("a", 7, 5), daemon=True)
+    t2 = threading.Thread(target=run, args=("b", 2, 3), daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(20)
+    t2.join(20)
+    assert not fail
+    assert seen["a"] == (7, 5)          # each thread saw ITS scope only
+    assert seen["b"] == (2, 3)
+    # the main thread (and the process default) never saw either scope
+    assert stream.upload_threads() == default_uploaders
+    assert stream.prefetch_depth() == default_depth
+
+
+def test_scoped_pool_size_resolves_per_thread(mesh):
+    data = _intdata()
+    src = _source(data, mesh, 4)._stream
+    got = {}
+
+    def other():
+        with stream.uploaders(3):
+            got["other"] = stream.pool_size(src)
+
+    with stream.uploaders(1):
+        th = threading.Thread(target=other, daemon=True)
+        th.start()
+        th.join(10)
+        got["main"] = stream.pool_size(src)
+    assert got == {"other": 3, "main": 1}
