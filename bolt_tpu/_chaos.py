@@ -1,0 +1,153 @@
+"""Deterministic chaos-injection registry: the blessed fault seams.
+
+Fault tolerance that is only exercised by real outages is fault
+tolerance that does not work.  This module gives the streaming executor,
+the checkpoint layer and the serving scheduler NAMED fault points —
+``chaos.hit("stream.upload")`` at the top of the uploader hot path,
+``"stream.dispatch"`` / ``"stream.fold"`` in the consumer,
+``"stream.checkpoint"`` in the checkpoint writer — and a registry that
+trips a chosen one deterministically:
+
+>>> from bolt_tpu import _chaos as chaos
+>>> chaos.inject("stream.upload", nth=3)          # 3rd upload raises
+>>> chaos.inject("stream.upload", nth=3, exc=IOError("link down"))
+>>> chaos.inject("stream.upload", nth=3, action="kill")   # SIGKILL self
+
+``nth`` counts hits process-wide (1-based); ``times`` bounds how many
+hits trip once armed (default 1 — a retried upload then succeeds,
+which is exactly how a flaky storage fetch behaves; ``times=None``
+keeps failing forever, the retries-exhausted shape).  ``action="kill"``
+delivers ``SIGKILL`` to the OWN process — the preemption test: nothing
+runs after it, no ``finally`` blocks, no atexit — which is why the
+checkpoint layer's atomic-rename discipline matters.
+
+The env form arms a point before any code runs, for subprocess tests::
+
+    BOLT_CHAOS="stream.upload:3:kill"       python job.py
+    BOLT_CHAOS="stream.upload:3:raise"      python job.py
+    BOLT_CHAOS="stream.upload:3:raise:disk gone" python job.py
+
+Disarmed cost is one module-global check per seam.  Lint rule BLT109
+keeps ``os.kill``/``signal`` fault injection in THIS file (and
+tests/scripts) only — production code must reach faults through these
+seams, never raise its own signals.
+
+Stdlib-only: importable by the checkpoint layer and by scripts with no
+jax in sight.
+"""
+
+import os
+import signal
+import threading
+
+_LOCK = threading.Lock()
+_POINTS = {}            # name -> _Spec
+_ARMED = False          # the one hot-path check
+
+
+class ChaosError(RuntimeError):
+    """The default exception an armed fault point raises."""
+
+
+class _Spec:
+    __slots__ = ("point", "nth", "exc", "action", "times", "hits",
+                 "trips")
+
+    def __init__(self, point, nth, exc, action, times):
+        self.point = point
+        self.nth = max(1, int(nth))
+        self.exc = exc
+        self.action = action
+        self.times = times          # None = unbounded
+        self.hits = 0
+        self.trips = 0
+
+
+def inject(point, nth=1, exc=None, action="raise", times=1):
+    """Arm fault point ``point`` to trip on its ``nth`` hit (1-based,
+    counted process-wide across threads).
+
+    ``action="raise"`` raises ``exc`` (default a :class:`ChaosError`
+    naming the point) INSIDE the instrumented seam — the thread-failure
+    variant, exercising the retry/abort paths; ``action="kill"``
+    delivers ``SIGKILL`` to this process — the preemption variant,
+    exercising checkpoint resume.  ``times`` bounds consecutive trips
+    once armed (``None`` = every hit from ``nth`` on)."""
+    if action not in ("raise", "kill"):
+        raise ValueError("chaos action must be 'raise' or 'kill', got %r"
+                         % (action,))
+    global _ARMED
+    with _LOCK:
+        _POINTS[point] = _Spec(point, nth, exc, action, times)
+        _ARMED = True
+    return _POINTS[point]
+
+
+def clear(point=None):
+    """Disarm one fault point (or all of them)."""
+    global _ARMED
+    with _LOCK:
+        if point is None:
+            _POINTS.clear()
+        else:
+            _POINTS.pop(point, None)
+        _ARMED = bool(_POINTS)
+
+
+def active():
+    """Names of the armed fault points."""
+    with _LOCK:
+        return sorted(_POINTS)
+
+
+def stats(point):
+    """``(hits, trips)`` for one point (``(0, 0)`` when never armed)."""
+    with _LOCK:
+        spec = _POINTS.get(point)
+        return (spec.hits, spec.trips) if spec is not None else (0, 0)
+
+
+def hit(point):
+    """The seam call: count one hit of ``point`` and trip the armed
+    fault when due.  ONE module-global check when nothing is armed —
+    the production cost of the whole registry."""
+    if not _ARMED:
+        return
+    with _LOCK:
+        spec = _POINTS.get(point)
+        if spec is None:
+            return
+        spec.hits += 1
+        due = spec.hits >= spec.nth and (
+            spec.times is None or spec.trips < spec.times)
+        if not due:
+            return
+        spec.trips += 1
+        action, exc = spec.action, spec.exc
+    if action == "kill":
+        # the preemption: no unwinding, no finally, no atexit — the
+        # process is simply gone, like a kill -9'd or preempted worker
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise exc if exc is not None else ChaosError(
+        "chaos: injected fault at %r (hit %d)" % (point, spec.hits))
+
+
+def _load_env():
+    """Arm a fault point from ``BOLT_CHAOS=point:nth:action[:message]``
+    — the subprocess form (the parent sets the env, the child trips it
+    with no code changes)."""
+    raw = os.environ.get("BOLT_CHAOS")
+    if not raw:
+        return
+    parts = raw.split(":", 3)
+    if len(parts) < 2:
+        raise ValueError(
+            "BOLT_CHAOS must be 'point:nth[:action[:message]]', got %r"
+            % raw)
+    point, nth = parts[0], int(parts[1])
+    action = parts[2] if len(parts) > 2 and parts[2] else "raise"
+    exc = ChaosError(parts[3]) if len(parts) > 3 else None
+    inject(point, nth=nth, exc=exc, action=action)
+
+
+_load_env()

@@ -47,6 +47,12 @@ Rules (the ``BLT1xx`` range; the abstract pipeline checker owns
   device-memory budget, the tenant counter scoping and the liveness
   guards (locks, events, and conditions are fine; it is thread
   *construction* that must be centralised).
+* **BLT109** — no ``os.kill``/``signal`` use outside ``_chaos.py``,
+  tests and scripts.  Fault injection has ONE blessed home — the
+  deterministic chaos registry (``bolt_tpu/_chaos.py``) and its named
+  seams; a stray ``os.kill``/``signal.signal`` in production code
+  bypasses the registry's determinism (nth-hit counting, env arming)
+  and turns the chaos harness's assertions into luck.
 
 A finding on line *N* is suppressed when that line carries a
 ``# lint: allow(BLT1xx <reason>)`` pragma — the escape hatch for the
@@ -69,6 +75,7 @@ RULES = {
     "BLT106": "raw time.perf_counter bookkeeping outside bolt_tpu.obs",
     "BLT107": "stray block_until_ready sync point outside the executor",
     "BLT108": "raw thread/executor construction outside stream.py/serve.py",
+    "BLT109": "os.kill/signal fault injection outside the chaos seams",
 }
 
 # rule -> path suffixes (os-normalised) exempt from it; an entry ending
@@ -89,6 +96,21 @@ _EXEMPT = {
     # the two blessed concurrency homes: the uploader pool + the
     # multi-tenant scheduler
     "BLT108": ("stream.py", "serve.py"),
+    # the one blessed fault-injection home (plus tests/scripts, whose
+    # whole job is to trip and observe faults)
+    "BLT109": ("_chaos.py", "tests" + os.sep, "scripts" + os.sep),
+}
+
+# process-signal fault calls BLT109 forbids outside the blessed seams
+_FAULT_CALLS = {
+    "os.kill",
+    "os.killpg",
+    "os.abort",
+    "signal.signal",
+    "signal.raise_signal",
+    "signal.pthread_kill",
+    "signal.setitimer",
+    "signal.alarm",
 }
 
 # constructors BLT108 forbids outside the blessed homes (dotted, alias-
@@ -372,6 +394,27 @@ def lint_source(src, path="<string>"):
                  "pipeline (the perf hazard the streaming executor's "
                  "bounded in-flight window exists to remove); let the "
                  "executor/profiling layers own synchronisation")
+
+        # ---- BLT109: os.kill / signal fault injection ------------------
+        if isinstance(node, ast.Call) \
+                and resolved(node.func) in _FAULT_CALLS:
+            emit("BLT109", node,
+                 "%s outside the blessed fault seams; route the fault "
+                 "through bolt_tpu._chaos.inject/hit (deterministic "
+                 "nth-hit counting, BOLT_CHAOS env arming) so the chaos "
+                 "harness can reproduce it" % resolved(node.func))
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "signal" or a.name.startswith("signal."):
+                    emit("BLT109", node,
+                         "import of the signal module outside the "
+                         "blessed fault seams; fault injection lives in "
+                         "bolt_tpu._chaos (lint rule BLT109)")
+        if isinstance(node, ast.ImportFrom) and node.module == "signal":
+            emit("BLT109", node,
+                 "import from the signal module outside the blessed "
+                 "fault seams; fault injection lives in bolt_tpu._chaos "
+                 "(lint rule BLT109)")
 
         # ---- BLT108: raw thread/executor construction ------------------
         if isinstance(node, ast.Call) \

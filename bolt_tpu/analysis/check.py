@@ -577,6 +577,31 @@ def _check_impl(obj):
     return rep
 
 
+def _note_resumable(src, idx, diags):
+    """``BLT011``: this streaming plan is checkpointed (a per-source
+    ``checkpoint=`` dir or an active ``stream.resumable()`` scope) but
+    its source is a ONE-SHOT iterator — the iterator dies with the
+    process, so a killed run can never re-stream the surviving slabs:
+    resume is impossible and every checkpoint write is wasted."""
+    from bolt_tpu import stream as _stream
+    scope = _stream.checkpoint_scope()
+    ck_dir = src.ckpt if src.ckpt is not None else (
+        scope[0] if scope is not None else None)
+    if ck_dir is None or src.kind != "iter" or src.blocks is None:
+        return
+    if iter(src.blocks) is not src.blocks:
+        return                      # re-iterable (a list of blocks): fine
+    diags.append(Diagnostic(
+        "BLT011", idx,
+        "resumable checkpointing is armed (dir %r) but this fromiter "
+        "source is a one-shot iterator: a killed run cannot re-stream "
+        "it, so resume is impossible and the checkpoint is wasted"
+        % ck_dir,
+        hint="use fromcallback (random access) or pass a re-iterable "
+             "block list so a restarted run can skip the already-"
+             "retired slabs"))
+
+
 def _check_stream(arr, target, stages, diags):
     """Abstractly interpret a STREAMING plan (a lazy ``fromcallback``/
     ``fromiter`` source plus its recorded device-side stages).  Nothing
@@ -597,6 +622,7 @@ def _check_stream(arr, target, stages, diags):
              % (nslabs, src.slab, _stream.prefetch_depth(),
                 _stream.pool_size(src))))
     _note_admission(_stream_slab_bytes(src), 0, diags)
+    _note_resumable(src, 0, diags)
     idle_seen = _idle_device_check(mesh, aval.shape, walk_split, 0, diags,
                                    False)
     dynamic = False

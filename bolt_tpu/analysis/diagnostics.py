@@ -29,6 +29,9 @@ CODES = {
     "BLT008": ("info", "result shape is dynamic until a count sync"),
     "BLT009": ("info", "fusable terminal set: one pass serves N stats"),
     "BLT010": ("error", "pipeline exceeds the serving admission budget"),
+    "BLT011": ("warning",
+               "one-shot iterator source under resumable(): resume "
+               "impossible"),
 }
 
 SEVERITIES = ("error", "warning", "info")

@@ -126,6 +126,15 @@ _SCHEMA = {
     "stream_inflight_high_water": 0,  # high-water slab programs
                                       # dispatched but not yet confirmed
                                       # complete (the async window)
+    # fault-tolerance accounting (ISSUE 9: resumable streams).  A retry
+    # is one re-attempted slab ingest (stream.retries / the serve layer's
+    # per-submit retries); a resume is one streamed run that restarted
+    # from a slab-level checkpoint instead of from scratch.
+    "stream_retries": 0,          # re-attempted slab ingests
+    "stream_resumes": 0,          # runs resumed from a checkpoint
+    "checkpoint_bytes": 0,        # partial-accumulator bytes persisted
+    "checkpoint_seconds": 0.0,    # wall time inside checkpoint writes
+                                  # (drain + host pull + atomic rename)
     # fused multi-terminal statistics (bolt.compute / a.stats(...) —
     # bolt_tpu/tpu/multistat.py): groups of N pending stat terminals
     # served by ONE tuple-output dispatch instead of N standalone passes
@@ -441,6 +450,24 @@ def record_transfer(nbytes, seconds):
     _COUNTERS.update(transfer_bytes=int(nbytes),
                      transfer_seconds=seconds)
     _TRANSFER_HIST.observe(int(nbytes))
+
+
+def record_stream_retry():
+    """Tally one re-attempted slab ingest (a failed uploader attempt
+    that was retried in place instead of poisoning the run)."""
+    _COUNTERS.add("stream_retries")
+
+
+def record_stream_resume():
+    """Tally one streamed run resumed from a slab-level checkpoint."""
+    _COUNTERS.add("stream_resumes")
+
+
+def record_checkpoint(nbytes, seconds):
+    """Tally one stream-checkpoint write (bolt_tpu.stream's resumable
+    path; the timeline carries it as the ``stream.checkpoint`` span)."""
+    _COUNTERS.update(checkpoint_bytes=int(nbytes),
+                     checkpoint_seconds=seconds)
 
 
 def record_stream(chunks, ingest_s, compute_s, wall_s, overlap_s, depth,

@@ -96,7 +96,7 @@ def rand(shape, context=None, axis=(0,), mode=None, dtype=None, seed=0):
 
 
 def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None,
-                 chunks=None):
+                 chunks=None, checkpoint=None):
     """Build a bolt array by calling ``fn(index_slices) -> block`` per
     index range — the sharded data-loader (extension beyond the reference
     factory, whose ``sc.parallelize`` scatter needs the full array at the
@@ -104,25 +104,32 @@ def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None,
     source — reduction terminals stream it slab-by-slab through the
     out-of-core executor (``bolt_tpu.stream``), other consumers
     materialise one call per device shard; ``chunks`` sets records per
-    streamed slab.  Local mode: one call for the whole array."""
+    streamed slab; ``checkpoint=dir`` makes every streamed run over the
+    source RESUMABLE (slab-level fold checkpoints — see
+    ``stream.resumable``).  Local mode: one call for the whole array."""
     cls = _lookup(context=context, mode=mode)
     if cls is ConstructLocal:
         return ConstructLocal.fromcallback(fn, shape, axis=axis, dtype=dtype)
     return ConstructTPU.fromcallback(fn, shape, context=context, axis=axis,
-                                     dtype=dtype, chunks=chunks)
+                                     dtype=dtype, chunks=chunks,
+                                     checkpoint=checkpoint)
 
 
-def fromiter(blocks, shape, context=None, axis=(0,), mode=None, dtype=None):
+def fromiter(blocks, shape, context=None, axis=(0,), mode=None, dtype=None,
+             checkpoint=None):
     """Build a bolt array from an ITERABLE of consecutive record blocks
     (key-axes-first layout along the first key axis) — the sequential
     streaming constructor for sources without random access.  ``dtype``
     is required.  ``mode='tpu'``: a lazy streaming source like
-    :func:`fromcallback`; local mode assembles the blocks on host."""
+    :func:`fromcallback` (``checkpoint=dir`` arms slab-level resume —
+    meaningful only for RE-ITERABLE block sources; a one-shot generator
+    dies with the process, which ``analysis.check`` flags as BLT011);
+    local mode assembles the blocks on host."""
     cls = _lookup(context=context, mode=mode)
     if cls is ConstructLocal:
         return ConstructLocal.fromiter(blocks, shape, axis=axis, dtype=dtype)
     return ConstructTPU.fromiter(blocks, shape, context=context, axis=axis,
-                                 dtype=dtype)
+                                 dtype=dtype, checkpoint=checkpoint)
 
 
 def concatenate(arrays, axis=0, context=None, mode=None):

@@ -94,6 +94,8 @@ _SCHEMA = {
     "failed": 0,               # jobs whose pipeline raised
     "queue_wait_seconds": 0.0,  # total submit->start wait
     "run_seconds": 0.0,        # total start->finish execution time
+    "retried": 0,              # per-submit retry attempts consumed
+    "expired": 0,              # jobs failed on their deadline= budget
 }
 
 
@@ -101,6 +103,12 @@ class AdmissionError(RuntimeError):
     """A submission the server refused: the bounded queue is full under
     ``policy="reject"``, or the pipeline's estimated device working set
     exceeds the arbiter's whole budget (BLT010 — it could never run)."""
+
+
+class DeadlineError(RuntimeError):
+    """A job's per-submit ``deadline=`` budget (seconds since submit)
+    expired before it could start; delivered through
+    ``Future.result()``."""
 
 
 # ---------------------------------------------------------------------
@@ -451,16 +459,32 @@ class Server:
         self._tenant_counters(tenant).add("rejected")
         raise AdmissionError(why)
 
-    def submit(self, pipeline, tenant="default"):
+    def submit(self, pipeline, tenant="default", retries=0,
+               deadline=None):
         """Queue ``pipeline`` for tenant ``tenant``; returns a
         :class:`Future`.  Raises :class:`AdmissionError` when the
         pipeline can never fit the arbiter budget (BLT010), or when the
         queue is full under ``policy="reject"``; under
         ``policy="queue"`` a full queue BLOCKS the submitter until a
-        worker frees a slot (backpressure, not unbounded memory)."""
+        worker frees a slot (backpressure, not unbounded memory).
+
+        Per-submit fault policy (ISSUE 9 — tenant failures stay
+        isolated): ``retries=n`` re-runs a raising job up to *n* times
+        on its worker (each attempt's exception chained to the one
+        before; the arbiter lease spans the attempts and is ALWAYS
+        returned); ``deadline=s`` bounds seconds-since-submit — a job
+        still queued past it fails with :class:`DeadlineError` instead
+        of running, and an expired deadline also stops further
+        retries.  Neither affects other tenants' futures."""
         if self._closing:
             raise RuntimeError("serve.Server is closed")
         tenant = str(tenant)
+        retries = max(0, int(retries))
+        if deadline is not None:
+            deadline = float(deadline)
+            if deadline <= 0:
+                raise ValueError("deadline must be positive seconds "
+                                 "since submit, got %r" % (deadline,))
         job, arr = _normalise(pipeline)
         est = _estimate(arr) if arr is not None else None
         if est is not None and est > self.arbiter.budget:
@@ -500,7 +524,8 @@ class Server:
                 # streaming pipelines lease per slab inside the
                 # executor; in-memory pipelines lease their estimated
                 # working set around the dispatch
-                q.append((fut, job, None if streaming else est))
+                q.append((fut, job, None if streaming else est, retries,
+                          deadline))
                 self._depth += 1
                 self._g_depth.set(self._depth)
                 self._g_depth_hw.high_water(self._depth)
@@ -539,12 +564,40 @@ class Server:
                     return None
                 self._cond.wait(0.05)
 
+    def _run_attempts(self, job, fut, tenant, nretry, deadline):
+        """Execute one job with its per-submit retry/deadline policy:
+        an expired deadline stops further attempts, and the chaining
+        (oldest-first back to the original; pointed error on an
+        exhausted budget; the untouched original at budget 0) is the
+        shared ``utils.chain_retry_step`` — one policy for serve AND
+        the streaming executor's slab retries."""
+        from bolt_tpu.utils import chain_retry_step
+        attempt = 0
+        prev = None
+        while True:
+            try:
+                return job()
+            except BaseException as exc:    # noqa: BLE001 — delivered
+                expired = deadline is not None and \
+                    _clock() - fut.submitted_s > deadline
+                allowed = attempt < nretry and not expired \
+                    and not self._cancel.is_set()
+                if allowed:
+                    self._counters.add("retried")
+                    self._tenant_counters(tenant).add("retried")
+                    _obs.event("serve.retry", tenant=tenant,
+                               attempt=attempt + 1,
+                               error=type(exc).__name__)
+                prev = chain_retry_step(exc, prev, attempt, allowed,
+                                        "serve job", "submit retries=")
+                attempt += 1
+
     def _worker(self):
         while True:
             got = self._pop()
             if got is None:
                 return
-            tenant, (fut, job, est) = got
+            tenant, (fut, job, est, nretry, deadline) = got
             fut.started_s = _clock()
             wait = fut.started_s - fut.submitted_s
             self._counters.add("queue_wait_seconds", wait)
@@ -555,6 +608,14 @@ class Server:
             lease = self.arbiter.lease(tenant) if est else None
             try:
                 with _engine.tenant(tenant):
+                    if deadline is not None and wait > deadline:
+                        # expired while queued: fail WITHOUT running —
+                        # the tenant's latency budget is already blown
+                        self._counters.add("expired")
+                        self._tenant_counters(tenant).add("expired")
+                        raise DeadlineError(
+                            "deadline %.3fs exceeded before the job "
+                            "started (queued %.3fs)" % (deadline, wait))
                     # stop on CANCEL only: a close(wait=True) drain must
                     # let queued leased jobs wait out the arbiter and run
                     if lease is not None and not lease.acquire(
@@ -562,7 +623,8 @@ class Server:
                         raise RuntimeError(
                             "server cancelled before the job's working "
                             "set (%d bytes) was granted" % est)
-                    out = job()
+                    out = self._run_attempts(job, fut, tenant, nretry,
+                                             deadline)
                 fut._finish(result=out)
                 key = "completed"
             except BaseException as exc:    # noqa: BLE001 — delivered
@@ -570,7 +632,7 @@ class Server:
                 key = "failed"
             finally:
                 if lease is not None:
-                    lease.close()
+                    lease.close()           # leases are ALWAYS returned
                 _obs.end(sp)
             run_s = fut.finished_s - fut.started_s
             self._counters.update(**{key: 1, "run_seconds": run_s})
@@ -620,7 +682,7 @@ class Server:
                 self._cancel.set()
                 while self._queues:
                     _, q = self._queues.popitem()
-                    for fut, _, _ in q:
+                    for fut, *_ in q:
                         fut._finish(exc=RuntimeError(
                             "serve.Server closed before this job ran"))
                 self._ring.clear()
@@ -683,7 +745,7 @@ def device_arbiter():
     return sv.arbiter if sv is not None else None
 
 
-def submit(pipeline, tenant="default"):
+def submit(pipeline, tenant="default", retries=0, deadline=None):
     """Submit through the active server, lazily starting the default
     one (env-tuned) when none is running."""
     global _ACTIVE
@@ -693,7 +755,8 @@ def submit(pipeline, tenant="default"):
             if _ACTIVE is None:
                 _ACTIVE = Server()
             sv = _ACTIVE
-    return sv.submit(pipeline, tenant=tenant)
+    return sv.submit(pipeline, tenant=tenant, retries=retries,
+                     deadline=deadline)
 
 
 @contextlib.contextmanager

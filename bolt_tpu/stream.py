@@ -56,13 +56,35 @@ from the same instrumented regions the obs spans cover (worker
 ingest time hidden behind device compute — ``max(0, ingest + compute -
 wall) / ingest`` per run.
 
-Fault model: a source callback or uploader worker that raises mid-stream
-aborts cleanly — the whole pool is joined, queued ring buffers are
-released, the partial reduction state is discarded, and the ORIGINAL
-exception is re-raised to the caller.  A pool thread that dies WITHOUT
-delivering (interpreter teardown, a killed thread) is detected by the
-consumer's liveness poll, which raises a pointed ``RuntimeError`` naming
-the dead thread instead of blocking forever.
+Fault model (ISSUE 9 made it three-tiered):
+
+* **fail-fast** (the default): a source callback or uploader worker that
+  raises mid-stream aborts cleanly — the whole pool is joined, queued
+  ring buffers are released, the partial reduction state is discarded,
+  and the ORIGINAL exception is re-raised to the caller.  A pool thread
+  that dies WITHOUT delivering (interpreter teardown, a killed thread)
+  is detected by the consumer's liveness poll, which raises a pointed
+  ``RuntimeError`` naming the dead thread instead of blocking forever;
+* **in-run retry** (``stream.retries(n)`` / ``BOLT_STREAM_RETRIES``): a
+  failed slab ingest is re-attempted up to *n* times before poisoning
+  the run — the slab re-runs in place on its worker, fenced through the
+  re-sequencer so a late duplicate of an earlier attempt can never
+  double-fold, and when the budget exhausts the final error chains every
+  attempt's exception back to the original failure;
+* **resume** (``stream.resumable(dir)`` / ``fromcallback``/``fromiter``
+  ``checkpoint=dir``): every ``BOLT_CHECKPOINT_EVERY`` retired slabs the
+  executor drains its async window and persists the retired-slab
+  watermark plus the folded partial accumulator (pairwise-tree levels +
+  the unpaired pair partial — moment triples and fused multi-stat
+  tuples included) via ``bolt_tpu.checkpoint.stream_save``.  A killed
+  run (preemption, ``kill -9``) restarted over the same source skips the
+  already-retired slabs, reloads the exact fold state, and produces a
+  result BIT-IDENTICAL to the uninterrupted run — the fold is a
+  deterministic function of (slab order, accumulator state), both of
+  which the checkpoint captures exactly.  A finished run clears its
+  checkpoint (no stale files).  Deterministic fault points for all of
+  this live in ``bolt_tpu._chaos`` (seams: ``stream.upload``,
+  ``stream.dispatch``, ``stream.fold``, ``stream.checkpoint``).
 """
 
 import contextlib
@@ -78,6 +100,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu import _chaos
 from bolt_tpu import engine as _engine
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.obs.trace import clock as _clock
@@ -195,6 +218,81 @@ def uploaders(n):
         st.pop()
 
 
+# in-run retry budget per slab: 0 = fail-fast (today's behavior), n = a
+# failed slab ingest re-attempts up to n times before poisoning the run
+_RETRIES = max(0, int(os.environ.get("BOLT_STREAM_RETRIES", "0")))
+
+# checkpoint cadence under resumable(): persist the fold state every k
+# retired slabs.  Each write drains the async window and pulls the
+# (value-shaped, small) partials to host — frequent checkpoints buy a
+# tighter resume point at a per-write pipeline stall.
+_CKPT_EVERY = max(1, int(os.environ.get("BOLT_CHECKPOINT_EVERY", "2")))
+
+
+def retry_limit():
+    """The active per-slab retry budget for the calling thread
+    (innermost :func:`retries` scope, else the process default;
+    0 = fail-fast)."""
+    st = _scope_stack("retries")
+    if st:
+        return st[-1]
+    return _RETRIES
+
+
+def set_retries(n):
+    """Set the process-wide DEFAULT per-slab retry budget; per-thread
+    :func:`retries` scopes override it."""
+    global _RETRIES
+    _RETRIES = max(0, int(n))
+
+
+@contextlib.contextmanager
+def retries(n):
+    """Scope the per-slab ingest retry budget::
+
+        with bolt_tpu.stream.retries(2):
+            flaky_src.map(f).sum()       # each slab survives 2 failures
+
+    THREAD-LOCAL like :func:`prefetch`/:func:`uploaders`: a serve
+    tenant's retry policy must not leak into a neighbour's run."""
+    st = _scope_stack("retries")
+    st.append(max(0, int(n)))
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def checkpoint_scope():
+    """The calling thread's innermost :func:`resumable` scope as
+    ``(dir, every)``, or ``None`` when streaming is not resumable."""
+    st = _scope_stack("ckpt")
+    return st[-1] if st else None
+
+
+@contextlib.contextmanager
+def resumable(dir, every=None):
+    """Scope slab-level checkpointing for streamed runs::
+
+        with bolt_tpu.stream.resumable("/ckpt/run17"):
+            src.map(f).sum()     # killed?  re-run resumes from the last
+                                 # retired slab, bit-identically
+
+    ``every`` is the checkpoint cadence in retired slabs (default
+    ``BOLT_CHECKPOINT_EVERY``, 2).  THREAD-LOCAL; a per-source
+    ``checkpoint=dir`` (``fromcallback``/``fromiter``) takes precedence
+    over the scope.  One-shot iterator sources cannot be resumed (the
+    iterator dies with the process) — ``analysis.check`` flags that
+    shape as BLT011."""
+    st = _scope_stack("ckpt")
+    st.append((os.fspath(dir),
+               max(1, int(every)) if every is not None else _CKPT_EVERY))
+    try:
+        yield
+    finally:
+        st.pop()
+
+
 def pool_size(source):
     """The uploader-pool size a run over ``source`` will use: the
     calling thread's configured count (scope/env), else ``min(mesh
@@ -281,6 +379,7 @@ def _upload_slab(block, mesh, split):
     detail, not payload), and every sub-block is blocked on before the
     seconds are recorded, so ``transfer_seconds`` stays honest."""
     from bolt_tpu.parallel import sharding as _sh
+    _chaos.hit("stream.upload")
     sp = _obs.begin("stream.transfer")
     t0 = _clock()
     try:
@@ -320,10 +419,10 @@ class StreamSource:
     fold without ever materialising a compaction buffer."""
 
     __slots__ = ("kind", "produce", "blocks", "shape", "split", "dtype",
-                 "mesh", "slab", "stages", "_state", "_consumed")
+                 "mesh", "slab", "stages", "ckpt", "_state", "_consumed")
 
     def __init__(self, kind, produce, blocks, shape, split, dtype, mesh,
-                 slab, stages=()):
+                 slab, stages=(), ckpt=None):
         self.kind = kind
         self.produce = produce          # callback: fn(index_slices)
         self.blocks = blocks            # iter: the iterable of blocks
@@ -333,6 +432,7 @@ class StreamSource:
         self.mesh = mesh
         self.slab = int(slab)
         self.stages = tuple(stages)
+        self.ckpt = ckpt                # resumable checkpoint dir (or None)
         self._state = None
         # iter sources stream ONCE per iter() of a one-shot iterable (a
         # generator cannot rewind); the cell is SHARED across derived
@@ -342,22 +442,27 @@ class StreamSource:
     # -- construction --------------------------------------------------
 
     @classmethod
-    def from_callback(cls, fn, shape, split, dtype, mesh, chunks=None):
+    def from_callback(cls, fn, shape, split, dtype, mesh, chunks=None,
+                      checkpoint=None):
         slab = _slab_records(shape, dtype, chunks)
-        return cls("callback", fn, None, shape, split, dtype, mesh, slab)
+        return cls("callback", fn, None, shape, split, dtype, mesh, slab,
+                   ckpt=checkpoint)
 
     @classmethod
-    def from_iter(cls, blocks, shape, split, dtype, mesh):
+    def from_iter(cls, blocks, shape, split, dtype, mesh,
+                  checkpoint=None):
         # slab sizes are whatever the iterator yields; the recorded slab
         # is only the default the shape/dtype imply (for repr/reports)
         slab = _slab_records(shape, dtype, None)
-        return cls("iter", None, blocks, shape, split, dtype, mesh, slab)
+        return cls("iter", None, blocks, shape, split, dtype, mesh, slab,
+                   ckpt=checkpoint)
 
     def with_stage(self, stage):
         """A new source sharing the host side, one device stage longer."""
         out = StreamSource(self.kind, self.produce, self.blocks,
                            self.shape, self.split, self.dtype, self.mesh,
-                           self.slab, self.stages + (stage,))
+                           self.slab, self.stages + (stage,),
+                           ckpt=self.ckpt)
         out._consumed = self._consumed      # same iterator, same budget
         return out
 
@@ -904,6 +1009,63 @@ class _PairFold:
         return acc
 
 
+def _make_fold(terminal, rfunc, comps, mesh, part):
+    """A fresh :class:`_PairFold` for one run, its merge-program factory
+    derived from a sample partial ``part`` — which may be a live device
+    value (the first pushed pair) OR a host array restored from a
+    checkpoint (the resume path rebuilds the fold around the persisted
+    levels).  Captures only shape/dtype: a factory closing over the
+    live partial would pin its device buffers for the whole run."""
+    if terminal in ("sum", "reduce"):
+        shape, dtype = part.shape, part.dtype
+        return _PairFold(lambda: _merge_program(terminal, shape, dtype,
+                                                rfunc, mesh))
+    if terminal == "multi":
+        sig = tuple((tuple(leaf.shape), str(leaf.dtype))
+                    for leaf in jax.tree_util.tree_leaves(part))
+
+        def factory():
+            mp = _merge_multi_program(comps, sig, mesh)
+            return lambda a, b: tuple(mp(a, b))
+        return _PairFold(factory)
+    mshape, mdtype = part[1].shape, part[1].dtype
+
+    def factory():
+        mp = _merge_program(terminal, mshape, mdtype, None, mesh)
+        return lambda a, b: tuple(mp(*a, *b))
+    return _PairFold(factory)
+
+
+def _stage_token(stage):
+    """One stage's fingerprint element: the kind, every callable by its
+    BYTECODE token (``utils.code_token`` — two lambdas with different
+    bodies differ, unlike ``__name__``), every plain value by repr."""
+    from bolt_tpu.utils import code_token
+    return "/".join(code_token(x) if callable(x) else repr(x)
+                    for x in stage)
+
+
+def _run_fingerprint(source, terminal, ddof, rfunc, specs):
+    """Identity of one LOGICAL streamed run for checkpoint matching:
+    source geometry + slab plan + stage chain + terminal, with every
+    user callable (stage funcs, the filter predicate, ``rfunc``, a
+    callback source's ``produce``) identified by its bytecode digest —
+    an EDITED pipeline over the same dir is refused, never resumed
+    wrong.  Closure DATA is not hashable (no checkpoint format's is):
+    re-pointing an identical loader at different bytes of the same
+    geometry is the caller's contract, as with any resume system."""
+    from bolt_tpu.utils import code_token
+    stages = "|".join(_stage_token(s) for s in source.stages)
+    members = "|".join("%s:%s" % (n, d) for n, d in specs) if specs else ""
+    return ("bolt-stream-ckpt-v1", str(terminal), str(ddof),
+            code_token(rfunc) if rfunc is not None else "",
+            "x".join(str(s) for s in source.shape),
+            int(source.split), str(source.dtype), int(source.slab),
+            str(source.kind),
+            code_token(source.produce) if source.produce is not None
+            else "", stages, members)
+
+
 # ---------------------------------------------------------------------
 # the executor
 # ---------------------------------------------------------------------
@@ -923,7 +1085,8 @@ class _Reseq:
     consumer, and a liveness poll catches pool threads that died without
     delivering (the ``q.get()``-blocks-forever bug)."""
 
-    __slots__ = ("_cond", "_slots", "_next", "_exc", "_total")
+    __slots__ = ("_cond", "_slots", "_next", "_exc", "_total", "_fenced",
+                 "_dead_err")
 
     def __init__(self):
         self._cond = threading.Condition()
@@ -931,11 +1094,27 @@ class _Reseq:
         self._next = 0
         self._exc = None
         self._total = None
+        self._fenced = 0
+        self._dead_err = None
 
     def put(self, i, item):
+        """Insert slab ``i``; returns False (dropping ``item``) for an
+        index already handed to the consumer or already queued — the
+        retry FENCE: a late duplicate from a slab's earlier attempt can
+        never double-fold, whatever interleaving delivered it."""
         with self._cond:
+            if i < self._next or i in self._slots:
+                self._fenced += 1
+                return False
             self._slots[i] = item
             self._cond.notify_all()
+            return True
+
+    @property
+    def fenced(self):
+        """Duplicate deliveries dropped by the fence."""
+        with self._cond:
+            return self._fenced
 
     def fault(self, exc):
         """Record the FIRST failure (later ones are consequences)."""
@@ -957,13 +1136,24 @@ class _Reseq:
 
     def _dead(self, threads):
         """Pointed error naming the dead pool threads — the fix for the
-        q.get()-blocks-forever bug."""
+        q.get()-blocks-forever bug.  Fires ONCE per dead thread set:
+        each dead thread is named exactly once (a pool with 2 dead
+        workers must not repeat the list), and repeated polls over the
+        same set return the SAME error object, so a chained message
+        cannot accumulate duplicates."""
         dead = [t for t in threads if not t.is_alive()] or threads
-        return RuntimeError(
+        key = tuple(sorted(t.ident or id(t) for t in dead))
+        cached = self._dead_err
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        names = list(dict.fromkeys(repr(t.name) for t in dead))
+        err = RuntimeError(
             "streaming prefetch thread(s) %s died without delivering "
             "slab %d or an error (thread killed before it could enqueue "
             "— e.g. interpreter teardown); the stream cannot complete"
-            % (", ".join(repr(t.name) for t in dead), self._next))
+            % (", ".join(names), self._next))
+        self._dead_err = (key, err)
+        return err
 
     def next(self, threads, workers=None, timeout=0.1, stall_limit=300,
              idle=None):
@@ -995,12 +1185,17 @@ class _Reseq:
         seen = -1
         while True:
             with self._cond:
-                if self._exc is not None:
-                    raise self._exc
+                # deliverable in-order slabs drain BEFORE a recorded
+                # fault raises: they are complete uploads that fold
+                # normally, and consuming them advances the resumable
+                # checkpoint watermark to the true last retired slab —
+                # the fault still re-raises on the first missing slab
                 if self._next in self._slots:
                     i = self._next
                     self._next += 1
                     return i, self._slots.pop(i)
+                if self._exc is not None:
+                    raise self._exc
                 if self._total is not None and self._next >= self._total:
                     return None
                 if not any(t.is_alive() for t in ingesters):
@@ -1079,7 +1274,39 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     # in the submitting tenant's scoped counters.
     tenant_tag = _engine.current_tenant()
     lease = _tenant_lease()
+    nretry = retry_limit()          # resolved HERE: scopes are per-thread
     rec_bytes = prod(source.shape[1:]) * source.dtype.itemsize
+    # resumable checkpointing (ISSUE 9): a per-source checkpoint dir
+    # (fromcallback/fromiter checkpoint=) wins over the thread's
+    # resumable() scope.  A matching checkpoint from a killed run is
+    # loaded BEFORE any thread starts: the dispenser then skips the
+    # already-retired slabs and the fold restarts from the persisted
+    # accumulator — bit-identical, because the fold is a deterministic
+    # function of (slab order, accumulator state) and both are exact.
+    scope = checkpoint_scope()
+    if source.ckpt is not None:
+        ck_dir = source.ckpt
+        ck_every = scope[1] if scope is not None else _CKPT_EVERY
+    elif scope is not None:
+        ck_dir, ck_every = scope
+    else:
+        ck_dir = ck_every = None
+    start_slab = 0
+    resume_records = 0
+    ck_state = None
+    ck_fp = None
+    if ck_dir is not None:
+        from bolt_tpu import checkpoint as _ckptlib
+        ck_fp = _run_fingerprint(source, terminal, ddof, rfunc, specs)
+        got_ck = _ckptlib.stream_load(ck_dir, ck_fp)
+        if got_ck is not None:
+            start_slab, resume_records, ck_state = got_ck
+            _engine.record_stream_resume()
+            _obs.event("stream.resume", slabs=start_slab,
+                       records=resume_records)
+    ranges = source.slab_ranges()[start_slab:] \
+        if source.kind == "callback" else None
+    total_slabs = len(ranges) if ranges is not None else None
     # the donated ring: at most depth + pool-size slab buffers exist at
     # once (each worker holds one in hand, depth more may wait uploaded
     # or dispatched-unconfirmed).  A permit is acquired per dispensed
@@ -1124,7 +1351,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
         never deadlock each other by acquiring out of order."""
         try:
             i = 0
-            for lo, hi in source.slab_ranges():
+            for lo, hi in ranges:
                 if not _acquire(permits, stop):
                     return
                 if lease is not None and not lease.acquire(
@@ -1139,6 +1366,23 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             for _ in range(nwork):
                 jobq.put(None)              # poison pills: pool drains
 
+    def _retry_or_raise(i, attempt, prev, exc):
+        """One failed ingest attempt: burn a retry (record + chain the
+        attempt's exception) or raise the run-poisoning final error —
+        the chaining policy itself is the shared
+        ``utils.chain_retry_step`` (one policy for stream AND serve).
+        At budget 0 the ORIGINAL exception propagates untouched — the
+        historical fail-fast contract."""
+        from bolt_tpu.utils import chain_retry_step
+        allowed = attempt < nretry and not stop.is_set()
+        if allowed:
+            _engine.record_stream_retry()
+            _obs.event("stream.retry", slab=start_slab + i,
+                       attempt=attempt + 1, error=type(exc).__name__)
+        return chain_retry_step(
+            exc, prev, attempt, allowed, "slab %d" % (start_slab + i),
+            "stream.retries / BOLT_STREAM_RETRIES")
+
     def worker(wid):
         try:
             with _engine.tenant(tenant_tag):
@@ -1147,21 +1391,35 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                     if job is None or stop.is_set():
                         return
                     i, lo, hi = job
-                    _act_enter()
-                    sp = _obs.begin("stream.ingest", parent=run_sp,
-                                    slab=i, worker=wid)
-                    t0 = _clock()
-                    try:
-                        block = source.produce_slab(lo, hi)
-                        buf = _upload_slab(block, mesh, split)
-                        tsec = _clock() - t0
-                        if sp is not None:
-                            sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
-                    finally:
+                    attempt = 0
+                    prev = None
+                    while True:
+                        _act_enter()
+                        sp = _obs.begin("stream.ingest", parent=run_sp,
+                                        slab=start_slab + i, worker=wid,
+                                        attempt=attempt)
+                        t0 = _clock()
+                        try:
+                            block = source.produce_slab(lo, hi)
+                            buf = _upload_slab(block, mesh, split)
+                            tsec = _clock() - t0
+                            if sp is not None:
+                                sp.set(bytes=int(block.nbytes), lo=lo,
+                                       hi=hi)
+                        except BaseException as exc:  # noqa: BLE001
+                            _obs.end(sp, error=type(exc).__name__)
+                            _act_exit()
+                            # retry IN PLACE on this worker (the job
+                            # keeps its ring permit and arbiter bytes);
+                            # the re-sequencer fences any duplicate
+                            prev = _retry_or_raise(i, attempt, prev, exc)
+                            attempt += 1
+                            continue
                         _obs.end(sp)
                         _act_exit()
+                        break
                     del block
-                    rsq.put(i, (buf, tsec))
+                    rsq.put(i, (buf, tsec, hi))
         except BaseException as exc:        # noqa: BLE001 — re-raised in
             rsq.fault(exc)                  # the consumer thread
 
@@ -1175,6 +1433,30 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
         try:
             with _engine.tenant(tenant_tag):
                 it = source.slabs()
+                if start_slab:
+                    # resume: drain the already-retired prefix, checking
+                    # the block layout still cuts at the checkpointed
+                    # record (a drifted iterator would silently corrupt
+                    # the fold — refuse instead)
+                    skipped_hi = 0
+                    for k in range(start_slab):
+                        try:
+                            _, skipped_hi, blk = next(it)
+                        except StopIteration:
+                            raise RuntimeError(
+                                "resume checkpoint covers %d slabs but "
+                                "this iterator ended after %d; the "
+                                "source is not the one the checkpoint "
+                                "was cut from" % (start_slab, k))
+                        del blk
+                    if skipped_hi != resume_records:
+                        raise RuntimeError(
+                            "resume checkpoint was cut at record %d but "
+                            "this iterator's first %d slab(s) cover %d "
+                            "records — the block layout drifted; delete "
+                            "the checkpoint or restore the original "
+                            "source" % (resume_records, start_slab,
+                                        skipped_hi))
                 while True:
                     if stop.is_set():
                         return
@@ -1182,7 +1464,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         return
                     _act_enter()
                     sp = _obs.begin("stream.ingest", parent=run_sp,
-                                    slab=i)
+                                    slab=start_slab + i)
                     t0 = _clock()
                     try:
                         try:
@@ -1195,7 +1477,19 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         if lease is not None and not lease.acquire(
                                 int(block.nbytes), stop=stop):
                             return
-                        buf = _upload_slab(block, mesh, split)
+                        attempt = 0
+                        prev = None
+                        while True:
+                            try:
+                                buf = _upload_slab(block, mesh, split)
+                                break
+                            except BaseException as exc:  # noqa: BLE001
+                                # the block is in hand (an iterator
+                                # cannot re-produce it), so the retry
+                                # budget covers the UPLOAD here
+                                prev = _retry_or_raise(i, attempt, prev,
+                                                       exc)
+                                attempt += 1
                         tsec = _clock() - t0
                         if sp is not None:
                             sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
@@ -1203,7 +1497,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         _obs.end(sp)
                         _act_exit()
                     del block
-                    rsq.put(i, (buf, tsec))
+                    rsq.put(i, (buf, tsec, hi))
                     i += 1
                 rsq.finish(i)
         except BaseException as exc:        # noqa: BLE001
@@ -1238,6 +1532,18 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     dispatched = 0
     confirmed = 0
     inflight_hw = 0
+    done_records = resume_records   # records covered by retired slabs
+    if ck_state is not None:
+        # restore the EXACT fold state the checkpoint captured: the
+        # pairwise-tree levels and the unpaired pair partial, as host
+        # arrays — the merge/fused programs accept them directly (the
+        # arithmetic is placement-independent, so the resumed result
+        # stays bit-identical to the uninterrupted run)
+        lv, pend = ck_state
+        sample = next((x for x in lv if x is not None), pend)
+        if sample is not None:
+            fold = _make_fold(terminal, rfunc, comps, mesh, sample)
+            fold.levels = list(lv)
 
     def _confirm_oldest():
         """Sync the OLDEST unconfirmed pair partial (normally long
@@ -1280,36 +1586,35 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             pend_bytes = 0
 
     def _fold_push(part):
+        # pair-partials fold as a PAIRWISE tree for every terminal —
+        # the moments merge included, so power-of-two slab counts keep
+        # the Chan denominators exact (level 0 is fused into the odd
+        # slab programs; this tree is level 1 and up)
         nonlocal fold
         if fold is None:
-            # pair-partials fold as a PAIRWISE tree for every terminal —
-            # the moments merge included, so power-of-two slab counts
-            # keep the Chan denominators exact (level 0 is fused into
-            # the odd slab programs; this tree is level 1 and up).
-            # Capture only shape/dtype: a factory closing over the live
-            # partial would pin its device buffers for the whole run.
-            if terminal in ("sum", "reduce"):
-                shape, dtype = part.shape, part.dtype
-                fold = _PairFold(lambda: _merge_program(
-                    terminal, shape, dtype, rfunc, mesh))
-            elif terminal == "multi":
-                sig = tuple(
-                    (tuple(leaf.shape), str(leaf.dtype))
-                    for leaf in jax.tree_util.tree_leaves(part))
-
-                def factory():
-                    mp = _merge_multi_program(comps, sig, mesh)
-                    return lambda a, b: tuple(mp(a, b))
-                fold = _PairFold(factory)
-            else:
-                mshape, mdtype = part[1].shape, part[1].dtype
-
-                def factory():
-                    mp = _merge_program(terminal, mshape, mdtype, None,
-                                        mesh)
-                    return lambda a, b: tuple(mp(*a, *b))
-                fold = _PairFold(factory)
+            fold = _make_fold(terminal, rfunc, comps, mesh, part)
         fold.push(part)
+
+    def _write_checkpoint():
+        """Persist the retired-slab watermark + fold state: drain the
+        async window first (permits and arbiter bytes release — the
+        persisted state must cover exactly the retired slabs), pull the
+        value-shaped partials to host, write atomically."""
+        while pending_sync:
+            _confirm_oldest()
+        state = (list(fold.levels) if fold is not None else [], pend)
+        csp = _obs.begin("stream.checkpoint",
+                         slabs=start_slab + nslabs)
+        t0 = _clock()
+        try:
+            jax.block_until_ready(state)
+            nb = _ckptlib.stream_save(ck_dir, ck_fp, start_slab + nslabs,
+                                      done_records, state)
+            _engine.record_checkpoint(nb, _clock() - t0)
+            if csp is not None:
+                csp.set(bytes=nb)
+        finally:
+            _obs.end(csp)
 
     for th in threads:
         th.start()
@@ -1321,11 +1626,13 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                                else None)
                 if got is None:
                     break
-                slab_i, (buf, tsec) = got
+                slab_i, (buf, tsec, slab_hi) = got
                 slab_bytes = int(buf.nbytes)
                 ingest += tsec
                 t0 = _clock()
-                csp = _obs.begin("stream.compute", slab=slab_i)
+                csp = _obs.begin("stream.compute",
+                                 slab=start_slab + slab_i)
+                _chaos.hit("stream.dispatch")
                 try:
                     with warnings.catch_warnings():
                         # backends without donation (the CPU dev mesh)
@@ -1352,11 +1659,16 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                             pending_sync.append(
                                 (2, pairp, pend_bytes + slab_bytes))
                             pend_bytes = 0
+                    # counted INSIDE the try, right after the fold state
+                    # absorbed the slab: the abort-path checkpoint below
+                    # keys its watermark off nslabs, and a watermark
+                    # lagging the state would double-fold on resume
+                    nslabs += 1
+                    done_records = slab_hi
                     del buf, got           # the donated ring slot is free
                 finally:
                     _obs.end(csp)
                 compute += _clock() - t0
-                nslabs += 1
                 dispatched += 1
                 if dispatched - confirmed > inflight_hw:
                     inflight_hw = dispatched - confirmed
@@ -1367,11 +1679,30 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                 # its ring permits and arbiter bytes)
                 while dispatched - confirmed > window and pending_sync:
                     _confirm_oldest()
+                # resumable(): persist the fold state every ck_every
+                # retired slabs (skipping the final slab of a known-size
+                # stream — the run is about to finish and clear anyway)
+                if ck_dir is not None and nslabs % ck_every == 0 \
+                        and not (total_slabs is not None
+                                 and nslabs >= total_slabs):
+                    _write_checkpoint()
             if pend is not None:
                 # odd slab count: the unpaired tail partial joins the
                 # tree as its own leaf (deterministic — slab order only)
                 _fold_push(pend)
                 pend = None
+        except BaseException:
+            # the run is failing (uploader death, source error, a
+            # chaos-injected fault): persist the retired-slab watermark
+            # FIRST, so the next run over this source resumes from here
+            # instead of from the last periodic checkpoint — best
+            # effort, never masking the original exception
+            if ck_dir is not None and nslabs:
+                try:
+                    _write_checkpoint()
+                except Exception:       # noqa: BLE001 — the original
+                    pass                # failure is the story
+            raise
         finally:
             stop.set()
             # the consumer's OWN poison pills: if the dispenser was
@@ -1390,6 +1721,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             raise RuntimeError(
                 "stream produced no slabs (empty source?) — nothing to "
                 "reduce; the materialised path owns empty-input rules")
+        _chaos.hit("stream.fold")
         fsp = _obs.begin("stream.fold", final=True)
         t0 = _clock()
         try:
@@ -1405,6 +1737,9 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             jax.block_until_ready(out)
         finally:
             _obs.end(fsp)
+        if ck_dir is not None:
+            # success: a finished run leaves NO stale checkpoint behind
+            _ckptlib.stream_clear(ck_dir)
         compute += _clock() - t0
         wall = _clock() - t_start
         overlap = max(0.0, ingest + compute - wall)

@@ -196,7 +196,7 @@ class ConstructTPU:
 
     @staticmethod
     def fromcallback(fn, shape, context=None, axis=(0,), dtype=None,
-                     chunks=None):
+                     chunks=None, checkpoint=None):
         """Build a distributed array by calling ``fn`` per index range —
         the sharded data-loader slot.
 
@@ -237,7 +237,8 @@ class ConstructTPU:
             # replays the per-shard upload below bit-identically
             from bolt_tpu import stream as _streamlib
             src = _streamlib.StreamSource.from_callback(
-                fn, shape, split, dtype, mesh, chunks=chunks)
+                fn, shape, split, dtype, mesh, chunks=chunks,
+                checkpoint=checkpoint)
             return BoltArrayTPU._streamed(src)
         # dtype=None means "whatever the callback produces" (the loader
         # knows its storage dtype); an explicit dtype converts each block
@@ -261,7 +262,8 @@ class ConstructTPU:
         return BoltArrayTPU(data, split, mesh)
 
     @staticmethod
-    def fromiter(blocks, shape, context=None, axis=(0,), dtype=None):
+    def fromiter(blocks, shape, context=None, axis=(0,), dtype=None,
+                 checkpoint=None):
         """Lazy streaming construction from an ITERABLE of consecutive
         record blocks — the sequential twin of :meth:`fromcallback` for
         sources that cannot random-access (a decompression stream, a
@@ -293,7 +295,8 @@ class ConstructTPU:
                 "its own devices' shards")
         from bolt_tpu import stream as _streamlib
         src = _streamlib.StreamSource.from_iter(blocks, shape, split,
-                                                dtype, mesh)
+                                                dtype, mesh,
+                                                checkpoint=checkpoint)
         return BoltArrayTPU._streamed(src)
 
     @staticmethod

@@ -408,3 +408,53 @@ def chunk_pad(plan, axes, padding, vshape):
                     % (p, plan[a], a))
             pad[a] = int(p)
     return pad
+
+
+def code_token(func):
+    """A process-stable identity token for a user callable: its name
+    plus a digest of its bytecode and constants (nested code objects
+    recursed).  Two lambdas with different bodies get DIFFERENT tokens
+    — unlike ``__name__``, which calls every lambda ``<lambda>`` — so
+    checkpoint fingerprints built from tokens refuse a resume across an
+    edited pipeline.  Callables without bytecode (ufuncs, builtins,
+    callable objects) fall back to their qualified name.  Data captured
+    in a closure is NOT part of the token (no checkpoint system can
+    hash the source's data; feeding a matching checkpoint the same
+    bytes is the caller's contract, as with any resume format)."""
+    import hashlib
+    code = getattr(func, "__code__", None)
+    name = getattr(func, "__name__", None) or type(func).__name__
+    if code is None:
+        return name
+
+    def feed(h, c):
+        h.update(c.co_code)
+        for const in c.co_consts:
+            if hasattr(const, "co_code"):
+                feed(h, const)
+            else:
+                h.update(repr(const).encode())
+
+    h = hashlib.sha1()
+    feed(h, code)
+    return "%s#%s" % (name, h.hexdigest()[:12])
+
+
+def chain_retry_step(exc, prev, attempt, allowed, what, knob):
+    """The ONE retry-chaining policy, shared by the streaming
+    executor's per-slab ingest retries and the serve scheduler's
+    per-submit job retries: chain this attempt's ``exc`` to the one
+    before (oldest-first, back to the original failure) and either
+    hand it back as the next attempt's ``prev`` (when another attempt
+    is ``allowed``) or raise — a pointed chained error when retries
+    were consumed, the ORIGINAL exception untouched at budget 0."""
+    if prev is not None and exc.__cause__ is None and exc is not prev:
+        exc.__cause__ = prev
+    if allowed:
+        return exc
+    if attempt:
+        raise RuntimeError(
+            "%s failed after %d retries (%s); the final attempt's "
+            "error is chained below, each attempt chained to the one "
+            "before" % (what, attempt, knob)) from exc
+    raise exc

@@ -139,6 +139,10 @@ def pipelines(mesh=None, nkeys=16):
     x9 = np.ones((k, 8, 4), np.float32)
     stream9 = bolt.fromcallback(lambda idx: x9[idx], (k, 8, 4), mesh,
                                 dtype=np.float32, chunks=max(1, k // 4))
+    x10 = (np.arange(k * 8 * 4, dtype=np.int64) % 7).astype(
+        np.float32).reshape(k, 8, 4)
+    stream10 = bolt.fromcallback(lambda idx: x10[idx], (k, 8, 4), mesh,
+                                 dtype=np.float32, chunks=max(1, k // 8))
     return [
         ("1 map->sum", bolt.array(np.ones((k, 8, 4), np.float32),
                                   mesh).map(ADD1)),
@@ -155,6 +159,7 @@ def pipelines(mesh=None, nkeys=16):
         ("7 stream_sum_parallel", stream7.map(ADD1)),
         ("8 multi_stat_fused", bolt.array(x8, mesh).map(ADD1)),
         ("9 serve_multitenant", stream9.map(ADD1)),
+        ("10 stream_resume", stream10.map(ADD1)),
     ]
 
 
@@ -299,6 +304,61 @@ def check_configs(mesh=None):
                   % (four9, one9, bit9, depth_hw, leaked9,
                      "OK" if ok9 else "MISMATCH"))
             failed = failed or not ok9
+        if name.startswith("10"):
+            # the resumable-streams gate (ISSUE 9): an uploader death
+            # mid-run must leave (a) a checkpoint whose re-run resumes
+            # BIT-IDENTICALLY, (b) zero leaked arbiter bytes — the
+            # failed run's lease returns everything, (c) zero leaked
+            # spans, (d) zero stale checkpoint files once the resumed
+            # run succeeds.
+            import tempfile
+            from bolt_tpu import _chaos as _cha
+            from bolt_tpu import checkpoint as _ckpt
+            from bolt_tpu import serve as _serve
+            from bolt_tpu import stream as _stream
+            from bolt_tpu.parallel import default_mesh
+            mesh10 = mesh if mesh is not None else default_mesh()
+            k10 = 16
+            x10 = (np.arange(k10 * 8 * 4, dtype=np.int64) % 7).astype(
+                np.float32).reshape(k10, 8, 4)
+
+            def make10(ck=None):
+                src = bolt.fromcallback(lambda idx: x10[idx],
+                                        (k10, 8, 4), mesh10,
+                                        dtype=np.float32, chunks=2,
+                                        checkpoint=ck)     # 8 slabs
+                return src.map(ADD1).sum()
+
+            ref10 = np.asarray(make10().toarray())
+            ckd = tempfile.mkdtemp(prefix="bolt-bench-resume-")
+            with _serve.serving(workers=1, budget_bytes=64 << 20) as sv:
+                _cha.inject("stream.upload", nth=5)
+                died = False
+                try:
+                    with _stream.uploaders(1):
+                        make10(ckd).cache()
+                except _cha.ChaosError:
+                    died = True
+                finally:
+                    _cha.clear()
+                leaked_fail = sv.stats()["arbiter"]["in_use_bytes"]
+                had_ckpt = _ckpt.stream_pending(ckd)
+                out10 = np.asarray(make10(ckd).toarray())
+                leaked_ok = sv.stats()["arbiter"]["in_use_bytes"]
+            ec10 = engine.counters()
+            leaked10 = obs.active_count()
+            ok10 = (died and had_ckpt and np.array_equal(out10, ref10)
+                    and leaked_fail == 0 and leaked_ok == 0
+                    and not _ckpt.stream_pending(ckd)
+                    and ec10["stream_resumes"] >= 1 and leaked10 == 0)
+            print("   uploader death mid-run: died %s | checkpoint "
+                  "written %s | resumed bit-identical %s | leaked "
+                  "arbiter bytes after fail/success: %d/%d | stale "
+                  "checkpoint files %s | leaked spans: %d -> %s"
+                  % (died, had_ckpt, np.array_equal(out10, ref10),
+                     leaked_fail, leaked_ok, _ckpt.stream_pending(ckd),
+                     leaked10, "OK" if ok10 else "MISMATCH"))
+            failed = failed or not ok10
     obs.disable()
     return 1 if failed else 0
 
@@ -710,6 +770,33 @@ def main():
              p50, p99, four9, one9, depth_hw9, lat9), file=sys.stderr)
     rows.append(_progress("9 serve_multitenant 4x128MB", ser9, conc9,
                           "exact*" if ok9 else "MISMATCH"))
+
+    # ---- config 10: resumable streams (ISSUE 9) ----------------------
+    # the kill -9 proof as a measured row: a child process streams the
+    # canonical 8-slab reduction, is SIGKILLed at upload 6 by the
+    # BOLT_CHAOS env, and a fresh child resumes from the surviving
+    # slab-level checkpoint.  "local s" is the clean child's in-run
+    # wall, "tpu s" the resumed child's (it streams only the remaining
+    # slabs — the gate is recovery < 1.5x clean, plus bit-identity).
+    import importlib.util as _ilu
+    _spec = _ilu.spec_from_file_location(
+        "chaos_run", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "chaos_run.py"))
+    _chaos_run = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_chaos_run)
+    r10 = _chaos_run.run_resume_bench()
+    ok10 = (r10["identical"] and r10["resumes"] >= 1
+            and not r10["stale_checkpoint"]
+            and r10["recovery_s"] < 1.5 * r10["clean_s"])
+    print("   stream_resume: killed rc=%s at upload 6/8, resumed %d of "
+          "%d slabs, recovery %.3fs vs clean %.3fs (gate < 1.5x), "
+          "bit-identical %s"
+          % (r10["killed_rc"], r10["slabs_resumed"], r10["slabs_total"],
+             r10["recovery_s"], r10["clean_s"], r10["identical"]),
+          file=sys.stderr)
+    rows.append(_progress("10 stream_resume kill -9", r10["clean_s"],
+                          r10["recovery_s"],
+                          "exact*" if ok10 else "MISMATCH"))
 
     print("%-26s %10s %10s %9s  %s" % ("config", "local s", "tpu s", "speedup", "parity"))
     for name, lt, tt, parity in rows:

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Chaos-injection harness for the resumable streaming executor (ISSUE 9).
+
+Proves the two kill-mid-run contracts end to end:
+
+* **thread-raise variant** (in process): ``_chaos.inject`` fires an
+  exception inside an uploader at a chosen slab; with ``stream.retries``
+  the run survives it in place, and without retries the run dies having
+  checkpointed — the re-run resumes from the last retired slab.  Either
+  way the result must be BIT-IDENTICAL to the uninterrupted run.
+* **subprocess ``kill -9`` variant**: a child process streams the same
+  reduction with ``BOLT_CHAOS=stream.upload:<n>:kill`` in its env and is
+  SIGKILLed mid-run — no unwinding, no ``finally`` — then a fresh child
+  resumes from the surviving checkpoint.  The harness asserts the
+  resumed result is bit-identical AND that recovery wall time stays
+  under 1.5x the clean run (the resumed child streams only the
+  remaining slabs).
+
+Usage::
+
+    python scripts/chaos_run.py            # run both variants, assert
+    python scripts/chaos_run.py --child .. # internal: one streamed run
+
+``bench_all.py`` config 10 (``stream_resume``) and the ``perf_regress``
+``stream_resume`` family reuse :func:`run_resume_bench`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+# the child's streamed workload: integer-valued f64 so sums are exact
+# under ANY fold order — "bit-identical" is then checkable against both
+# the clean child run and the NumPy oracle
+N_RECORDS = 64
+VSHAPE = (16, 8)
+CHUNKS = 8                       # -> 8 slabs
+PACE_S = 0.02                    # per-slab storage-fetch pacing: keeps
+#                                  the checkpoint cadence ahead of the
+#                                  kill (and emulates a real loader)
+
+
+def _data():
+    n = N_RECORDS * int(np.prod(VSHAPE))
+    return ((np.arange(n) % 13) - 6).astype(np.float64).reshape(
+        (N_RECORDS,) + VSHAPE)
+
+
+def child_main(argv):
+    """One streamed run over the canonical workload: the kill target.
+    Writes the result array and a JSON sidecar (in-run wall seconds +
+    fault counters) — a SIGKILLed child writes neither, which is the
+    point."""
+    import jax
+    import bolt_tpu as bolt
+    from bolt_tpu import engine
+    from bolt_tpu.obs.trace import clock
+
+    args = dict(zip(argv[::2], argv[1::2]))
+    ck_dir, out = args["--dir"], args["--out"]
+    data = _data()
+
+    def loader(idx):
+        time.sleep(PACE_S)
+        return data[idx]
+
+    mesh = jax.make_mesh((jax.device_count(),), ("k",))
+    src = bolt.fromcallback(loader, data.shape, mesh, dtype=np.float64,
+                            chunks=CHUNKS, checkpoint=ck_dir)
+    t0 = clock()
+    res = np.asarray(src.sum().toarray())
+    wall = clock() - t0
+    np.save(out, res)
+    ec = engine.counters()
+    with open(out + ".json", "w") as f:
+        json.dump({"wall": wall, "resumes": ec["stream_resumes"],
+                   "retries": ec["stream_retries"],
+                   "chunks": ec["stream_chunks"],
+                   "checkpoint_bytes": ec["checkpoint_bytes"]}, f)
+    return 0
+
+
+def _run_child(ck_dir, out, chaos=None):
+    env = dict(os.environ)
+    env["BOLT_STREAM_UPLOAD_THREADS"] = "1"   # deterministic watermark
+    env.pop("BOLT_CHAOS", None)
+    if chaos:
+        env["BOLT_CHAOS"] = chaos
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "--dir", ck_dir, "--out", out],
+        env=env, capture_output=True, text=True, timeout=600)
+    return proc
+
+
+def run_resume_bench(kill_at=6, workdir=None):
+    """The subprocess kill -9 proof, packaged for the bench harness:
+    clean child run, SIGKILLed child (``BOLT_CHAOS`` arms the kill at
+    upload ``kill_at`` of 8), resumed child.  Returns the measurement
+    dict; raises on a child that failed for any reason OTHER than the
+    intended kill."""
+    from bolt_tpu import checkpoint as ckpt
+    workdir = workdir or tempfile.mkdtemp(prefix="bolt-chaos-")
+    ck_dir = os.path.join(workdir, "ckpt")
+    clean_out = os.path.join(workdir, "clean.npy")
+    resume_out = os.path.join(workdir, "resumed.npy")
+
+    proc = _run_child(ck_dir, clean_out)
+    if proc.returncode != 0:
+        raise RuntimeError("clean chaos child failed:\n%s" % proc.stderr)
+    with open(clean_out + ".json") as f:
+        clean = json.load(f)
+
+    proc = _run_child(ck_dir, resume_out,
+                      chaos="stream.upload:%d:kill" % kill_at)
+    killed_rc = proc.returncode
+    if killed_rc == 0:
+        raise RuntimeError("chaos child was supposed to die and did not")
+    if not ckpt.stream_pending(ck_dir):
+        raise RuntimeError(
+            "killed child left no checkpoint (rc=%s):\n%s"
+            % (killed_rc, proc.stderr))
+
+    proc = _run_child(ck_dir, resume_out)
+    if proc.returncode != 0:
+        raise RuntimeError("resume chaos child failed:\n%s" % proc.stderr)
+    with open(resume_out + ".json") as f:
+        resumed = json.load(f)
+
+    res_clean = np.load(clean_out)
+    res_resumed = np.load(resume_out)
+    oracle = _data().sum(axis=0)
+    return {
+        "clean_s": clean["wall"],
+        "recovery_s": resumed["wall"],
+        "killed_rc": killed_rc,
+        "resumes": resumed["resumes"],
+        "slabs_resumed": resumed["chunks"],
+        "slabs_total": clean["chunks"],
+        "identical": bool(np.array_equal(res_clean, res_resumed)
+                          and np.array_equal(res_resumed, oracle)),
+        "stale_checkpoint": ckpt.stream_pending(ck_dir),
+    }
+
+
+def run_thread_variant():
+    """The in-process half: an uploader RAISES mid-run.  Covers both
+    policies — retries absorb the fault in one run; without retries the
+    failed run checkpoints and the re-run resumes.  Returns the
+    measurement dict (all booleans must be True)."""
+    import jax
+    import bolt_tpu as bolt
+    from bolt_tpu import _chaos as chaos, checkpoint as ckpt, engine, stream
+
+    data = _data()
+    mesh = jax.make_mesh((jax.device_count(),), ("k",))
+
+    def make(ck=None):
+        return bolt.fromcallback(lambda idx: data[idx], data.shape, mesh,
+                                 dtype=np.float64, chunks=CHUNKS,
+                                 checkpoint=ck)
+
+    clean = np.asarray(make().sum().toarray())
+
+    # retry policy: the fault is absorbed in-run
+    chaos.inject("stream.upload", nth=3)
+    c0 = engine.counters()
+    with stream.retries(1):
+        retried = np.asarray(make().sum().toarray())
+    c1 = engine.counters()
+    chaos.clear()
+    retry_ok = (np.array_equal(retried, clean)
+                and c1["stream_retries"] - c0["stream_retries"] == 1)
+
+    # checkpoint + resume: the fault kills the run
+    ck_dir = tempfile.mkdtemp(prefix="bolt-chaos-thread-")
+    chaos.inject("stream.upload", nth=5)
+    died = False
+    try:
+        with stream.uploaders(1):
+            make(ck_dir).sum().cache()
+    except chaos.ChaosError:
+        died = True
+    chaos.clear()
+    c2 = engine.counters()
+    resumed = np.asarray(make(ck_dir).sum().toarray())
+    c3 = engine.counters()
+    return {
+        "retry_ok": retry_ok,
+        "died": died,
+        "checkpointed": c2["checkpoint_bytes"] > c1["checkpoint_bytes"],
+        "resumed": c3["stream_resumes"] - c2["stream_resumes"] == 1,
+        "identical": bool(np.array_equal(resumed, clean)),
+        "stale_checkpoint": ckpt.stream_pending(ck_dir),
+    }
+
+
+def main():
+    print("== thread-raise variant (in process)")
+    tv = run_thread_variant()
+    print("   %s" % json.dumps(tv))
+    ok = (tv["retry_ok"] and tv["died"] and tv["checkpointed"]
+          and tv["resumed"] and tv["identical"]
+          and not tv["stale_checkpoint"])
+    print("   -> %s" % ("OK" if ok else "MISMATCH"))
+
+    print("== subprocess kill -9 variant")
+    kv = run_resume_bench()
+    print("   %s" % json.dumps(kv))
+    bounded = kv["recovery_s"] < 1.5 * kv["clean_s"]
+    ok2 = (kv["identical"] and kv["resumes"] >= 1
+           and kv["slabs_resumed"] < kv["slabs_total"]
+           and not kv["stale_checkpoint"] and bounded)
+    print("   recovery %.3fs vs clean %.3fs (gate < 1.5x) -> %s"
+          % (kv["recovery_s"], kv["clean_s"],
+             "OK" if ok2 else "MISMATCH"))
+    return 0 if ok and ok2 else 1
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        sys.exit(child_main(sys.argv[2:]))
+    sys.exit(main())

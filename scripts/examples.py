@@ -251,6 +251,53 @@ def main():
             pass
 
     # ------------------------------------------------------------------
+    section("8f. survive a preemption: resumable streams + retry")
+    # out-of-core runs over hours of data must survive worker failure:
+    # checkpoint=dir persists the retired-slab watermark + fold state,
+    # stream.retries absorbs flaky ingest in-run, and a killed run
+    # restarted over the same source resumes BIT-IDENTICALLY from the
+    # last retired slab.  The deterministic fault registry
+    # (bolt_tpu._chaos) plays the failures on demand.
+    import tempfile
+    from bolt_tpu import _chaos as chaos
+    from bolt_tpu import checkpoint as _ck
+    from bolt_tpu import stream as _stream
+    xr = rs.randn(64, 16, 8).astype(np.float32)
+    ckd = tempfile.mkdtemp()
+
+    def resumable_pipeline(ck=ckd):
+        src = bolt.fromcallback(lambda idx: xr[idx], xr.shape, mesh,
+                                dtype=np.float32, chunks=8,  # 8 slabs
+                                checkpoint=ck)
+        return src.map(lambda v: v + 1.0).sum()
+
+    expected = np.asarray(resumable_pipeline(ck=None).toarray())
+    # a flaky upload is absorbed in-run by the retry budget (the slab
+    # re-attempts in place, fenced so it can never double-fold)
+    chaos.inject("stream.upload", nth=2)
+    with _stream.retries(1):
+        got = np.asarray(resumable_pipeline().toarray())
+    chaos.clear()
+    assert np.array_equal(got, expected)
+    # a KILLED run leaves a checkpoint; the re-run resumes from the
+    # last retired slab and the result is bit-identical
+    chaos.inject("stream.upload", nth=5)
+    try:
+        with _stream.uploaders(1):
+            resumable_pipeline().cache()
+        raise AssertionError("chaos fault did not fire")
+    except chaos.ChaosError:
+        pass
+    finally:
+        chaos.clear()
+    assert _ck.stream_pending(ckd)              # the watermark survived
+    got2 = np.asarray(resumable_pipeline().toarray())    # resumes
+    assert np.array_equal(got2, expected)       # bit-identical
+    assert not _ck.stream_pending(ckd)          # success cleared it
+    ec = bolt.profile.engine_counters()
+    assert ec["stream_resumes"] >= 1 and ec["stream_retries"] >= 1
+
+    # ------------------------------------------------------------------
     section("9. time-series pipeline: detrend -> zscore -> PCA")
     # per-pixel calcium-imaging-style workflow: remove each pixel's slow
     # drift, standardise, then find the dominant temporal components —

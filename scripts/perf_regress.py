@@ -492,6 +492,76 @@ def fam_serve_multitenant():
                          "ingest latency emulated at %gs" % lat)}
 
 
+def fam_stream_resume():
+    # the ISSUE-9 fault-tolerance family: an injected uploader death
+    # kills a resumable streamed reduction mid-run; the re-run resumes
+    # from the last retired-slab checkpoint.  s_per_iter is RECOVERY —
+    # the resumed run's wall clock (it streams only the remaining
+    # slabs, so recovery_over_clean < 1 is the healthy shape; > 1.5
+    # means resume stopped saving work).  The retry leg rides along:
+    # one injected fault absorbed in-run by stream.retries(1), counted.
+    import tempfile
+    from bolt_tpu import _chaos as chaos
+    from bolt_tpu import checkpoint as ckpt
+    from bolt_tpu import stream as _stream
+    shape = (2048, 256, 64)                       # 128 MB, 8 slabs
+    x = (np.arange(np.prod(shape), dtype=np.int64) % 251).astype(
+        np.float32).reshape(shape)
+
+    def make(ck=None):
+        src = bolt.fromcallback(lambda idx: x[idx], shape, mode="tpu",
+                                dtype=np.float32, chunks=256,
+                                checkpoint=ck)
+        return src.map(MAPSUM_FN).sum()
+
+    jax.device_get(_tiny(make().cache().tojax()))     # compile
+    t0 = time.perf_counter()
+    ref = make().cache()
+    jax.device_get(_tiny(ref.tojax()))
+    clean = time.perf_counter() - t0
+
+    d = tempfile.mkdtemp(prefix="bolt-perf-resume-")
+    ec0 = bolt.profile.engine_counters()
+    chaos.inject("stream.upload", nth=6)              # die at slab 6/8
+    try:
+        with _stream.uploaders(1):
+            make(d).cache()
+    except Exception:
+        pass
+    finally:
+        chaos.clear()
+    t0 = time.perf_counter()
+    out = make(d).cache()
+    jax.device_get(_tiny(out.tojax()))
+    recovery = time.perf_counter() - t0
+    ec1 = bolt.profile.engine_counters()
+    identical = bool(np.array_equal(np.asarray(ref.toarray()),
+                                    np.asarray(out.toarray())))
+
+    chaos.inject("stream.upload", nth=3)              # the retry leg
+    try:
+        with _stream.retries(1):
+            jax.device_get(_tiny(make().cache().tojax()))
+    finally:
+        chaos.clear()
+    ec2 = bolt.profile.engine_counters()
+    return int(np.prod(shape)) * 4, recovery, {
+        "bound": "transfer",
+        "recovery_seconds": round(recovery, 5),
+        "clean_seconds": round(clean, 5),
+        "recovery_over_clean": round(recovery / clean, 2),
+        "resumes": ec1["stream_resumes"] - ec0["stream_resumes"],
+        "retries": ec2["stream_retries"] - ec1["stream_retries"],
+        "checkpoint_bytes": ec1["checkpoint_bytes"],
+        "bit_identical": identical,
+        "stale_checkpoint": ckpt.stream_pending(d),
+        "traffic": (1.0, "recovery pass: only the slabs past the "
+                         "retired-slab checkpoint re-stream; the gbps "
+                         "figure is input bytes over RECOVERY wall, so "
+                         "it exceeds the clean-run link rate when "
+                         "resume is doing its job")}
+
+
 def fam_pca_default():
     # the SAME pca program under the bolt.precision("default") scope —
     # PERF.json records both policy modes for the precision-bound
@@ -524,6 +594,7 @@ FAMILIES = [
     ("stream_sum", fam_stream_sum),
     ("multi_stat_fused", fam_multi_stat_fused),
     ("serve_multitenant", fam_serve_multitenant),
+    ("stream_resume", fam_stream_resume),
 ]
 
 
@@ -643,7 +714,11 @@ def main():
                     "fused_stat_groups", "fused_stat_terminals",
                     "tenants", "p50_s", "p99_s", "serialized_s",
                     "aggregate_over_serialized",
-                    "queue_depth_high_water", "arbiter_waits"):
+                    "queue_depth_high_water", "arbiter_waits",
+                    "recovery_seconds", "clean_seconds",
+                    "recovery_over_clean", "resumes", "retries",
+                    "checkpoint_bytes", "bit_identical",
+                    "stale_checkpoint"):
             if meta.get(key) is not None:
                 entry[key] = meta[key]
         if phases:

@@ -304,6 +304,8 @@ _EXPECTED_ENGINE_KEYS = {
     "stream_compute_seconds": True, "stream_wall_seconds": True,
     "stream_overlap_seconds": True, "stream_prefetch_depth": False,
     "stream_upload_threads": False, "stream_inflight_high_water": False,
+    "stream_retries": False, "stream_resumes": False,
+    "checkpoint_bytes": False, "checkpoint_seconds": True,
     "fused_stat_groups": False, "fused_stat_terminals": False,
     "coalesced_builds": False, "coalesced_compiles": False,
 }

@@ -1,0 +1,596 @@
+"""Resumable streams (ISSUE 9): slab-level checkpointing, uploader
+retry with fencing, and the chaos-injection harness.
+
+The load-bearing contract is the kill-mid-run proof: with a
+deterministic fault killing an uploader — a thread-level raise AND a
+subprocess ``kill -9`` — a streamed ``sum`` / ``stats("sum", "var")``
+over ≥ 8 slabs resumes from the last retired-slab checkpoint and the
+result is BIT-IDENTICAL to the uninterrupted run.  Around it: the
+``_chaos`` registry's determinism, the in-run retry budget (absorbed
+faults, chained exhaustion, re-sequencer fencing against double-folds),
+checkpoint hygiene (fingerprint mismatch refused, success clears, no
+torn meta), the orbax-less checkpoint degradation, BLT011, BLT109, and
+the deduped dead-thread report.
+"""
+
+import importlib.util
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import bolt_tpu as bolt
+from bolt_tpu import _chaos as chaos
+from bolt_tpu import analysis, checkpoint, engine, stream
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N = 32
+SHAPE = (N, 6, 4)
+
+
+@pytest.fixture(autouse=True)
+def _no_armed_chaos():
+    """Every test leaves the fault registry empty (an armed point would
+    sabotage whichever test streams next)."""
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _intdata():
+    """Integer-valued f64: sums are exact under any fold order, so
+    'bit-identical' is checkable against the oracle too."""
+    return ((np.arange(np.prod(SHAPE)) % 13) - 6).astype(
+        np.float64).reshape(SHAPE)
+
+
+def _source(data, mesh, ck=None, chunks=4):
+    return bolt.fromcallback(lambda idx: data[idx], data.shape, mesh,
+                             dtype=np.float64, chunks=chunks,
+                             checkpoint=ck)
+
+
+# ---------------------------------------------------------------------
+# the chaos registry
+# ---------------------------------------------------------------------
+
+def test_chaos_nth_hit_and_times():
+    chaos.inject("t.seam", nth=3)
+    chaos.hit("t.seam")
+    chaos.hit("t.seam")
+    with pytest.raises(chaos.ChaosError, match="t.seam"):
+        chaos.hit("t.seam")
+    chaos.hit("t.seam")                  # times=1: disarmed after 1 trip
+    assert chaos.stats("t.seam") == (4, 1)
+    chaos.clear("t.seam")
+    assert chaos.active() == []
+
+
+def test_chaos_custom_exc_and_unbounded_times():
+    chaos.inject("t.seam2", nth=1, exc=IOError("link down"), times=None)
+    for _ in range(3):
+        with pytest.raises(IOError, match="link down"):
+            chaos.hit("t.seam2")
+    assert chaos.stats("t.seam2") == (3, 3)
+
+
+def test_chaos_env_form(monkeypatch):
+    monkeypatch.setenv("BOLT_CHAOS", "x.y:2:raise:disk gone")
+    chaos._load_env()
+    chaos.hit("x.y")
+    with pytest.raises(chaos.ChaosError, match="disk gone"):
+        chaos.hit("x.y")
+    with pytest.raises(ValueError, match="point:nth"):
+        monkeypatch.setenv("BOLT_CHAOS", "malformed")
+        chaos._load_env()
+
+
+def test_chaos_rejects_unknown_action():
+    with pytest.raises(ValueError, match="raise.*kill"):
+        chaos.inject("t.x", action="explode")
+
+
+def test_chaos_disarmed_is_free():
+    # the production cost: one module-global check, no lookup
+    assert not chaos._ARMED
+    chaos.hit("never.armed")             # no-op, no counting
+    assert chaos.stats("never.armed") == (0, 0)
+
+
+# ---------------------------------------------------------------------
+# in-run retry: absorbed faults, chained exhaustion, fencing
+# ---------------------------------------------------------------------
+
+def test_retry_scope_and_env(monkeypatch):
+    before = stream.retry_limit()
+    assert before == 0                   # default: fail-fast
+    with stream.retries(3):
+        assert stream.retry_limit() == 3
+    assert stream.retry_limit() == before
+    stream.set_retries(2)
+    try:
+        assert stream.retry_limit() == 2
+    finally:
+        stream.set_retries(before)
+
+
+def test_retry_absorbs_uploader_fault_bit_identical(mesh):
+    data = _intdata()
+    clean = np.asarray(_source(data, mesh).sum().toarray())
+    chaos.inject("stream.upload", nth=3)         # one trip, then healthy
+    c0 = engine.counters()
+    with stream.retries(2):
+        got = np.asarray(_source(data, mesh).sum().toarray())
+    c1 = engine.counters()
+    assert np.array_equal(got, clean)
+    assert c1["stream_retries"] - c0["stream_retries"] == 1
+
+
+def test_retry_exhausted_chains_back_to_original(mesh):
+    data = _intdata()
+    chaos.inject("stream.upload", nth=2, times=None)   # never heals
+    with stream.retries(2):
+        with pytest.raises(RuntimeError, match="after 2 retries") as ei:
+            _source(data, mesh).sum().cache()
+    # final error -> last attempt -> ... -> the ORIGINAL failure
+    e = ei.value.__cause__
+    depth = 0
+    while e is not None:
+        assert isinstance(e, chaos.ChaosError)
+        e = e.__cause__
+        depth += 1
+    assert depth == 3                    # 1 original + 2 retries
+
+
+def test_default_zero_retries_keeps_original_exception(mesh):
+    data = _intdata()
+    boom = RuntimeError("storage went away")
+    chaos.inject("stream.upload", nth=2, exc=boom)
+    with pytest.raises(RuntimeError) as ei:
+        _source(data, mesh).sum().cache()
+    assert ei.value is boom              # untouched, unchained
+
+
+def test_retry_covers_fromiter_upload(mesh):
+    data = _intdata()
+    clean = np.asarray(bolt.fromiter([data], SHAPE, mesh,
+                                     dtype=np.float64).sum().toarray())
+    chaos.inject("stream.upload", nth=1)
+    c0 = engine.counters()
+    with stream.retries(1):
+        got = np.asarray(bolt.fromiter([data], SHAPE, mesh,
+                                       dtype=np.float64).sum().toarray())
+    c1 = engine.counters()
+    assert np.array_equal(got, clean)
+    assert c1["stream_retries"] - c0["stream_retries"] == 1
+
+
+def test_reseq_fences_duplicate_deliveries():
+    r = stream._Reseq()
+    assert r.put(0, "a") and r.put(1, "b")
+    assert not r.put(1, "late duplicate")        # still queued
+    got = r.next([threading.current_thread()])
+    assert got == (0, "a")
+    assert not r.put(0, "after retirement")      # already folded
+    assert r.fenced == 2
+    assert r.next([threading.current_thread()]) == (1, "b")
+
+
+def test_dead_workers_each_named_once(mesh, monkeypatch):
+    # TWO dead workers: the pointed error must name each exactly once
+    # (the dedupe satellite), not repeat the list per poll
+    monkeypatch.setattr(stream._Reseq, "fault", lambda self, exc: None)
+
+    def dying(idx):
+        raise RuntimeError("swallowed by the mute")
+
+    src = bolt.fromcallback(dying, SHAPE, mesh, dtype=np.float64,
+                            chunks=4)
+    with stream.uploaders(2):
+        with pytest.raises(RuntimeError,
+                           match="died without delivering") as ei:
+            src.sum().cache()
+    msg = str(ei.value)
+    for w in ("'bolt-stream-upload-0'", "'bolt-stream-upload-1'"):
+        assert msg.count(w) == 1, (w, msg)
+
+
+def test_dead_error_fires_once_per_dead_set():
+    r = stream._Reseq()
+
+    class _T:
+        def __init__(self, name):
+            self.name = name
+            self.ident = id(self)
+
+        def is_alive(self):
+            return False
+
+    a, b = _T("w-0"), _T("w-1")
+    e1 = r._dead([a, b])
+    e2 = r._dead([a, b])
+    assert e1 is e2                      # same set -> the SAME error
+    assert str(e1).count("'w-0'") == 1 and str(e1).count("'w-1'") == 1
+    c = _T("w-2")
+    assert r._dead([a, b, c]) is not e1  # a new set is a new report
+
+
+# ---------------------------------------------------------------------
+# the kill-mid-run proof, thread-raise variant (>= 8 slabs)
+# ---------------------------------------------------------------------
+
+def test_resume_sum_bit_identical_thread_raise(mesh, tmp_path):
+    data = _intdata()
+    ck = str(tmp_path / "ck")
+    clean = np.asarray(_source(data, mesh).sum().toarray())
+    chaos.inject("stream.upload", nth=5)         # die at slab 5 of 8
+    c0 = engine.counters()
+    with pytest.raises(chaos.ChaosError):
+        with stream.uploaders(1):
+            _source(data, mesh, ck=ck).sum().cache()
+    chaos.clear()
+    c1 = engine.counters()
+    assert checkpoint.stream_pending(ck)         # the watermark survived
+    assert c1["checkpoint_bytes"] > c0["checkpoint_bytes"]
+    assert c1["checkpoint_seconds"] > c0["checkpoint_seconds"]
+    got = np.asarray(_source(data, mesh, ck=ck).sum().toarray())
+    c2 = engine.counters()
+    assert np.array_equal(got, clean)            # BIT-identical
+    assert np.array_equal(got, (data).sum(axis=0))
+    assert c2["stream_resumes"] - c1["stream_resumes"] == 1
+    # the resumed run streamed FEWER than all 8 slabs
+    assert c2["stream_chunks"] - c1["stream_chunks"] < 8
+    assert not checkpoint.stream_pending(ck)     # success cleared it
+
+
+def test_resume_multi_stat_bit_identical(mesh, tmp_path):
+    # streamed stats("sum", "var"): the fused tuple accumulator (sum +
+    # (n, mu, M2) moments) must checkpoint and resume bit-identically
+    rs = np.random.RandomState(5)
+    data = rs.randn(*SHAPE)
+    ck = str(tmp_path / "ck")
+
+    def run(ckdir=None):
+        out = _source(data, mesh, ck=ckdir).stats("sum", "var")
+        return {k: np.asarray(v.toarray()) for k, v in out.items()}
+
+    ref = run()
+    chaos.inject("stream.upload", nth=5)
+    with pytest.raises(chaos.ChaosError):
+        with stream.uploaders(1):
+            vals = _source(data, mesh, ck=ck).stats("sum", "var")
+            [v.cache() for v in vals.values()]
+    chaos.clear()
+    assert checkpoint.stream_pending(ck)
+    got = run(ckdir=ck)
+    for k in ref:
+        assert np.array_equal(got[k], ref[k]), k
+    assert not checkpoint.stream_pending(ck)
+
+
+def test_resume_var_through_resumable_scope(mesh, tmp_path):
+    # the scope form (no per-source dir) + a moments terminal
+    data = _intdata()
+    ck = str(tmp_path / "ck")
+    clean = np.asarray(_source(data, mesh).var().toarray())
+    chaos.inject("stream.upload", nth=5)
+    with pytest.raises(chaos.ChaosError):
+        with stream.resumable(ck), stream.uploaders(1):
+            _source(data, mesh).var().cache()
+    chaos.clear()
+    assert checkpoint.stream_pending(ck)
+    with stream.resumable(ck):
+        got = np.asarray(_source(data, mesh).var().toarray())
+    assert np.array_equal(got, clean)
+    assert not checkpoint.stream_pending(ck)
+
+
+def test_resume_fromiter_reiterable(mesh, tmp_path):
+    data = _intdata()
+    blocks = [data[:8], data[8:16], data[16:24], data[24:]]
+    ck = str(tmp_path / "ck")
+
+    def make(ckdir=None):
+        return bolt.fromiter(blocks, SHAPE, mesh, dtype=np.float64,
+                             checkpoint=ckdir)
+
+    clean = np.asarray(make().mean().toarray())
+    chaos.inject("stream.upload", nth=3)
+    with pytest.raises(chaos.ChaosError):
+        make(ck).mean().cache()
+    chaos.clear()
+    assert checkpoint.stream_pending(ck)
+    got = np.asarray(make(ck).mean().toarray())
+    assert np.array_equal(got, clean)
+
+
+def test_resume_fromiter_layout_drift_refused(mesh, tmp_path):
+    data = _intdata()
+    ck = str(tmp_path / "ck")
+    blocks = [data[:8], data[8:16], data[16:24], data[24:]]
+    chaos.inject("stream.upload", nth=3)
+    with pytest.raises(chaos.ChaosError):
+        bolt.fromiter(blocks, SHAPE, mesh, dtype=np.float64,
+                      checkpoint=ck).sum().cache()
+    chaos.clear()
+    assert checkpoint.stream_pending(ck)
+    # a DIFFERENT block layout cannot satisfy the record watermark
+    drifted = [data[:16], data[16:]]
+    with pytest.raises(RuntimeError, match="drifted|ended after"):
+        bolt.fromiter(drifted, SHAPE, mesh, dtype=np.float64,
+                      checkpoint=ck).sum().cache()
+
+
+def test_stale_checkpoint_other_pipeline_ignored(mesh, tmp_path):
+    # a checkpoint cut from sum() must NOT seed a mean() over the same
+    # dir: the fingerprint mismatch means a from-scratch (correct) run
+    data = _intdata()
+    ck = str(tmp_path / "ck")
+    chaos.inject("stream.upload", nth=5)
+    with pytest.raises(chaos.ChaosError):
+        with stream.uploaders(1):
+            _source(data, mesh, ck=ck).sum().cache()
+    chaos.clear()
+    assert checkpoint.stream_pending(ck)
+    c0 = engine.counters()
+    got = np.asarray(_source(data, mesh, ck=ck).mean().toarray())
+    c1 = engine.counters()
+    assert c1["stream_resumes"] == c0["stream_resumes"]   # no resume
+    assert c1["stream_chunks"] - c0["stream_chunks"] == 8  # full stream
+    want = np.asarray(bolt.array(data, mesh).mean().toarray())
+    assert np.array_equal(got, want)
+
+
+def test_checkpoint_write_failure_surfaces_then_heals(mesh, tmp_path):
+    # the checkpoint-write seam is itself a chaos point: a failing
+    # write aborts the run (the fault funnel), and the executor is not
+    # poisoned afterwards
+    data = _intdata()
+    ck = str(tmp_path / "ck")
+    chaos.inject("stream.checkpoint", nth=1)
+    with pytest.raises(chaos.ChaosError):
+        _source(data, mesh, ck=ck).sum().cache()
+    chaos.clear()
+    got = np.asarray(_source(data, mesh, ck=ck).sum().toarray())
+    assert np.array_equal(got, data.sum(axis=0))
+    assert not checkpoint.stream_pending(ck)
+
+
+def test_resumed_run_zero_new_compiles_second_resume(mesh, tmp_path):
+    # resuming twice over the same geometry reuses every executable the
+    # first resume compiled (the host-array acc signature included)
+    data = _intdata()
+    ck = str(tmp_path / "ck")
+    clean = np.asarray(_source(data, mesh).sum().toarray())
+    # first kill at upload 5 of 8; the SECOND run resumes (only ~4
+    # slabs left) and is killed again at its upload 2
+    for nth in (5, 2):
+        chaos.inject("stream.upload", nth=nth)
+        with pytest.raises(chaos.ChaosError):
+            with stream.uploaders(1):
+                _source(data, mesh, ck=ck).sum().cache()
+        chaos.clear()
+    c0 = engine.counters()
+    got = np.asarray(_source(data, mesh, ck=ck).sum().toarray())
+    c1 = engine.counters()
+    assert np.array_equal(got, clean)
+    assert c1["misses"] - c0["misses"] <= 2      # resume-signature twins
+
+
+# ---------------------------------------------------------------------
+# the kill-mid-run proof, subprocess kill -9 variant
+# ---------------------------------------------------------------------
+
+def test_subprocess_kill9_resume_bit_identical(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "chaos_run", os.path.join(REPO, "scripts", "chaos_run.py"))
+    chaos_run = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos_run)
+    r = chaos_run.run_resume_bench(workdir=str(tmp_path))
+    assert r["killed_rc"] != 0                   # SIGKILL, not an exit
+    assert r["resumes"] >= 1                     # resumed, not restarted
+    assert r["slabs_resumed"] < r["slabs_total"]
+    assert r["identical"]                        # bit-identical result
+    assert not r["stale_checkpoint"]
+
+
+# ---------------------------------------------------------------------
+# checkpoint layer: atomicity order, orbax degradation
+# ---------------------------------------------------------------------
+
+def test_stream_meta_written_last_state_first(tmp_path, monkeypatch):
+    # a crash between the two renames must leave NO meta (checkpoint
+    # "does not exist") rather than meta pointing at missing state
+    calls = []
+    real_replace = os.replace
+
+    def tracing_replace(a, b):
+        calls.append(os.path.basename(b))
+        return real_replace(a, b)
+
+    monkeypatch.setattr(os, "replace", tracing_replace)
+    checkpoint.stream_save(str(tmp_path), ("fp",), 2, 8,
+                           ([np.ones(3)], None))
+    assert calls == ["stream_state.npz", "stream_meta.json"]
+
+
+def test_stream_clear_removes_meta_first(tmp_path, monkeypatch):
+    checkpoint.stream_save(str(tmp_path), ("fp",), 2, 8,
+                           ([np.ones(3)], None))
+    removed = []
+    real_remove = os.remove
+
+    def tracing_remove(p):
+        removed.append(os.path.basename(p))
+        return real_remove(p)
+
+    monkeypatch.setattr(os, "remove", tracing_remove)
+    checkpoint.stream_clear(str(tmp_path))
+    assert removed == ["stream_meta.json", "stream_state.npz"]
+    checkpoint.stream_clear(str(tmp_path))       # idempotent
+
+
+def test_torn_meta_state_pair_refused(tmp_path):
+    # a kill BETWEEN the state rename and the meta rename leaves the
+    # OLD meta next to the NEW state; the watermark cross-check inside
+    # the state file must refuse the pair (resuming it would fold the
+    # stale watermark onto the newer accumulator — double-counting)
+    import shutil
+    fp = ("fp",)
+    checkpoint.stream_save(str(tmp_path), fp, 2, 8, ([np.ones(3)], None))
+    meta = os.path.join(str(tmp_path), "stream_meta.json")
+    shutil.copy(meta, meta + ".old")
+    checkpoint.stream_save(str(tmp_path), fp, 4, 16,
+                           ([np.full(3, 2.0)], None))
+    assert checkpoint.stream_load(str(tmp_path), fp) is not None
+    os.replace(meta + ".old", meta)      # the torn window, reproduced
+    assert checkpoint.stream_load(str(tmp_path), fp) is None
+    # a consistent pair loads again
+    checkpoint.stream_save(str(tmp_path), fp, 6, 24, ([np.ones(3)], None))
+    assert checkpoint.stream_load(str(tmp_path), fp)[0] == 6
+
+
+def test_edited_pipeline_fingerprint_refused(mesh, tmp_path):
+    # same dir, same geometry, EDITED stage body: the bytecode-token
+    # fingerprint must refuse the checkpoint — both lambdas are
+    # "<lambda>" by name, which is exactly why names are not enough
+    data = _intdata()
+    ck = str(tmp_path / "ck")
+    chaos.inject("stream.upload", nth=5)
+    with pytest.raises(chaos.ChaosError):
+        with stream.uploaders(1):
+            _source(data, mesh, ck=ck).map(lambda v: v + 1).sum().cache()
+    chaos.clear()
+    assert checkpoint.stream_pending(ck)
+    c0 = engine.counters()
+    got = np.asarray(_source(data, mesh, ck=ck)
+                     .map(lambda v: v * 2).sum().toarray())
+    c1 = engine.counters()
+    assert c1["stream_resumes"] == c0["stream_resumes"]   # refused
+    assert np.array_equal(got, (data * 2).sum(axis=0))    # correct
+
+
+def test_code_token_distinguishes_lambda_bodies():
+    from bolt_tpu.utils import code_token
+    a = code_token(lambda v: v + 1)
+    b = code_token(lambda v: v * 2)
+    c = code_token(lambda v: v + 2)      # same bytecode, different const
+    assert a != b and a != c and b != c
+    assert a.startswith("<lambda>#")
+    assert code_token(np.maximum) == "maximum"   # no bytecode: name
+    # stable across definitions of the same source shape
+    assert code_token(lambda v: v + 1) == a
+
+
+def test_checkpoint_save_without_orbax_npy_fallback(mesh, tmp_path,
+                                                    monkeypatch):
+    x = np.random.RandomState(1).randn(8, 4)
+    b = bolt.array(x, mesh)
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    path = str(tmp_path / "ck")
+    checkpoint.save(path, b)                     # degrades, no raise
+    assert os.path.exists(os.path.join(path, "array.npy"))
+    r = checkpoint.load(path, context=mesh)      # loads without orbax
+    assert np.allclose(np.asarray(r.toarray()), x)
+    assert r.split == 1
+
+
+def test_checkpoint_npy_format_readable_with_orbax_back(mesh, tmp_path,
+                                                        monkeypatch):
+    x = np.random.RandomState(2).randn(8, 4)
+    with monkeypatch.context() as m:
+        m.setitem(sys.modules, "orbax", None)
+        m.setitem(sys.modules, "orbax.checkpoint", None)
+        checkpoint.save(str(tmp_path / "ck"), bolt.array(x, mesh))
+    # orbax restored: the npy-format checkpoint still loads
+    r = checkpoint.load(str(tmp_path / "ck"), context=mesh)
+    assert np.allclose(np.asarray(r.toarray()), x)
+
+
+def test_checkpoint_multiprocess_without_orbax_pointed_error(
+        mesh, tmp_path, monkeypatch):
+    b = bolt.array(np.ones((4, 2)), mesh)
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ImportError, match="orbax-checkpoint"):
+        checkpoint.save(str(tmp_path / "c"), b)
+
+
+# ---------------------------------------------------------------------
+# BLT011 + BLT109
+# ---------------------------------------------------------------------
+
+def test_blt011_one_shot_iterator_under_resumable(mesh, tmp_path):
+    data = _intdata()
+
+    def gen():
+        yield data
+
+    src = bolt.fromiter(gen(), SHAPE, mesh, dtype=np.float64,
+                        checkpoint=str(tmp_path))
+    rep = analysis.check(src)
+    assert rep.has("BLT011")
+    assert rep.ok                        # warning severity, not error
+    [d] = [d for d in rep.diagnostics if d.code == "BLT011"]
+    assert d.severity == "warning" and "one-shot" in d.message
+    # re-iterable block lists resume fine: no finding
+    lst = bolt.fromiter([data], SHAPE, mesh, dtype=np.float64)
+    with stream.resumable(str(tmp_path)):
+        assert not analysis.check(lst).has("BLT011")
+    # no checkpointing armed: quiet
+    assert not analysis.check(
+        bolt.fromiter(gen(), SHAPE, mesh, dtype=np.float64)).has("BLT011")
+
+
+@pytest.mark.lint
+def test_blt109_signal_rule_seeded():
+    from bolt_tpu.analysis import astlint
+    bad = "import os\n\ndef f(pid):\n    os.kill(pid, 9)\n"
+    assert any(f.code == "BLT109"
+               for f in astlint.lint_source(bad, "bolt_tpu/somewhere.py"))
+    badsig = "import signal\n\nsignal.signal(2, None)\n"
+    found = astlint.lint_source(badsig, "bolt_tpu/elsewhere.py")
+    assert any(f.code == "BLT109" for f in found)
+    # alias-aware, like every chain rule
+    bad3 = "import os as o\n\ndef f(p):\n    o.kill(p, 9)\n"
+    assert any(f.code == "BLT109"
+               for f in astlint.lint_source(bad3, "bolt_tpu/x.py"))
+    # the blessed homes pass
+    assert not astlint.lint_source(bad, "bolt_tpu/_chaos.py")
+    assert not astlint.lint_source(bad, "tests/test_whatever.py")
+    assert not astlint.lint_source(bad, "scripts/chaos_run.py")
+    # and the whole package still lints clean (BLT109 included)
+    assert astlint.lint_package() == []
+
+
+# ---------------------------------------------------------------------
+# obs + arbiter hygiene under failure
+# ---------------------------------------------------------------------
+
+def test_failed_and_resumed_runs_leak_no_spans(mesh, tmp_path):
+    from bolt_tpu import obs
+    data = _intdata()
+    ck = str(tmp_path / "ck")
+    obs.clear()
+    obs.enable()
+    try:
+        chaos.inject("stream.upload", nth=5)
+        with pytest.raises(chaos.ChaosError):
+            with stream.uploaders(1):
+                _source(data, mesh, ck=ck).sum().cache()
+        chaos.clear()
+        assert obs.active_count() == 0           # failed run: no leaks
+        _source(data, mesh, ck=ck).sum().cache()
+        assert obs.active_count() == 0           # resumed run: no leaks
+        names = {s.name for s in obs.spans()}
+        assert "stream.checkpoint" in names
+    finally:
+        obs.disable()
+        obs.clear()

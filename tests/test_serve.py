@@ -489,3 +489,114 @@ def test_concurrent_streamed_runs_aggregate_faster_than_serial(mesh):
             return
     pytest.fail("3 concurrent latency-bound tenants never beat serial "
                 "(serial %.3fs, concurrent %.3fs)" % (serial, concurrent))
+
+
+# ---------------------------------------------------------------------
+# fault policy (ISSUE 9): tenant-failure isolation, per-submit
+# retries= / deadline=
+# ---------------------------------------------------------------------
+
+def test_tenant_stream_failure_returns_lease_and_isolates(mesh):
+    # ONE tenant's streamed pipeline dies mid-run: its future carries
+    # the original error, its arbiter lease bytes come back, and the
+    # OTHER tenants' futures are untouched
+    x = _x()
+    boom = RuntimeError("tenant-a storage died")
+    fired = []
+
+    def flaky(idx):
+        fired.append(idx)
+        if len(fired) >= 2:
+            raise boom
+        return x[idx]
+
+    ref = (x + 1).sum(axis=0)
+    with serve.serving(workers=2, budget_bytes=64 << 20) as sv:
+        bad = bolt.fromcallback(flaky, x.shape, mesh, dtype=np.float32,
+                                chunks=16).map(ADD1).sum()
+        fa = sv.submit(bad, tenant="iso-a")
+        fbs = [sv.submit(_pipeline(x, mesh), tenant="iso-b")
+               for _ in range(3)]
+        with pytest.raises(RuntimeError, match="storage died"):
+            fa.result(timeout=120)
+        for f in fbs:                      # neighbours unaffected
+            assert np.allclose(np.asarray(f.result(timeout=120)
+                                          .toarray()), ref)
+        st = sv.stats()
+        assert st["arbiter"]["in_use_bytes"] == 0   # lease returned
+        assert st["tenants"]["iso-a"]["failed"] == 1
+        assert st["tenants"]["iso-b"]["completed"] == 3
+        assert st["tenants"]["iso-b"]["failed"] == 0
+
+
+def test_submit_retries_reruns_and_counts(mesh):
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("transient %d" % len(calls))
+        return "ok"
+
+    with serve.serving(workers=1) as sv:
+        f = sv.submit(flaky, tenant="r", retries=2)
+        assert f.result(timeout=60) == "ok"
+        assert len(calls) == 3
+        st = sv.stats()
+        assert st["totals"]["retried"] == 2
+        assert st["tenants"]["r"]["retried"] == 2
+        assert st["tenants"]["r"]["completed"] == 1
+
+
+def test_submit_retries_exhausted_chains_attempts(mesh):
+    def always():
+        raise ValueError("still broken")
+
+    with serve.serving(workers=1) as sv:
+        f = sv.submit(always, tenant="r", retries=1)
+        exc = f.exception(timeout=60)
+    assert isinstance(exc, RuntimeError) and "after 1 retries" in str(exc)
+    assert isinstance(exc.__cause__, ValueError)          # final attempt
+    assert isinstance(exc.__cause__.__cause__, ValueError)  # original
+
+
+def test_submit_deadline_expires_in_queue(mesh):
+    release = threading.Event()
+
+    def blocker():
+        release.wait(30)
+        return 1
+
+    with serve.serving(workers=1) as sv:
+        f1 = sv.submit(blocker, tenant="x")
+        f2 = sv.submit(lambda: 2, tenant="x", deadline=0.05)
+        time.sleep(0.2)                    # the deadline passes queued
+        release.set()
+        assert f1.result(timeout=60) == 1
+        with pytest.raises(serve.DeadlineError,
+                           match="before the job started"):
+            f2.result(timeout=60)
+        st = sv.stats()
+        assert st["totals"]["expired"] == 1
+        assert st["totals"]["failed"] >= 1
+
+
+def test_submit_deadline_stops_retries(mesh):
+    calls = []
+
+    def failing():
+        calls.append(1)
+        time.sleep(0.08)
+        raise ValueError("attempt %d" % len(calls))
+
+    with serve.serving(workers=1) as sv:
+        f = sv.submit(failing, tenant="d", retries=50, deadline=0.1)
+        exc = f.exception(timeout=60)
+    assert isinstance(exc, (ValueError, RuntimeError))
+    assert len(calls) < 50                 # the deadline cut retries off
+
+
+def test_submit_deadline_validation(mesh):
+    with serve.serving(workers=1) as sv:
+        with pytest.raises(ValueError, match="positive"):
+            sv.submit(lambda: 1, deadline=0)
