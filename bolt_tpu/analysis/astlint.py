@@ -53,6 +53,16 @@ Rules (the ``BLT1xx`` range; the abstract pipeline checker owns
   seams; a stray ``os.kill``/``signal.signal`` in production code
   bypasses the registry's determinism (nth-hit counting, env arming)
   and turns the chaos harness's assertions into luck.
+* **BLT110** — no ``jax.distributed`` / ``jax.process_index`` /
+  ``jax.process_count`` outside ``parallel/multihost.py`` (and
+  ``_compat.py``).  Process topology has ONE blessed home: the
+  multi-process bootstrap, the per-process ingest contract and the
+  rendezvous barriers all live in ``bolt_tpu.parallel.multihost``; a
+  scattered ``jax.process_index()`` probe bypasses the pod bring-up
+  policy (gloo arming on CPU, idempotent initialize) and the BLT012
+  divisibility reasoning that module centralises.  Device attributes
+  (``dev.process_index``) are data, not topology calls, and stay
+  allowed.
 
 A finding on line *N* is suppressed when that line carries a
 ``# lint: allow(BLT1xx <reason>)`` pragma — the escape hatch for the
@@ -76,6 +86,8 @@ RULES = {
     "BLT107": "stray block_until_ready sync point outside the executor",
     "BLT108": "raw thread/executor construction outside stream.py/serve.py",
     "BLT109": "os.kill/signal fault injection outside the chaos seams",
+    "BLT110": "jax.distributed/process-topology call outside "
+              "parallel/multihost.py",
 }
 
 # rule -> path suffixes (os-normalised) exempt from it; an entry ending
@@ -99,6 +111,17 @@ _EXEMPT = {
     # the one blessed fault-injection home (plus tests/scripts, whose
     # whole job is to trip and observe faults)
     "BLT109": ("_chaos.py", "tests" + os.sep, "scripts" + os.sep),
+    # the one blessed process-topology home (plus _compat for any
+    # version-sensitive spelling, and tests/scripts, which stand up the
+    # localhost clusters themselves)
+    "BLT110": (os.path.join("parallel", "multihost.py"), "_compat.py",
+               "tests" + os.sep, "scripts" + os.sep),
+}
+
+# process-topology calls BLT110 confines to parallel/multihost.py
+_TOPOLOGY_CALLS = {
+    "jax.process_index",
+    "jax.process_count",
 }
 
 # process-signal fault calls BLT109 forbids outside the blessed seams
@@ -394,6 +417,39 @@ def lint_source(src, path="<string>"):
                  "pipeline (the perf hazard the streaming executor's "
                  "bounded in-flight window exists to remove); let the "
                  "executor/profiling layers own synchronisation")
+
+        # ---- BLT110: jax.distributed / process-topology calls ----------
+        if isinstance(node, ast.Call) \
+                and resolved(node.func) in _TOPOLOGY_CALLS:
+            emit("BLT110", node,
+                 "%s outside the blessed topology home; route it "
+                 "through bolt_tpu.parallel.multihost (process_index/"
+                 "process_count/is_multiprocess), which owns the pod "
+                 "bring-up policy" % resolved(node.func))
+        if isinstance(node, ast.Attribute) \
+                and resolved(node) == "jax.distributed":
+            emit("BLT110", node,
+                 "jax.distributed outside the blessed topology home; "
+                 "bootstrap/teardown live in bolt_tpu.parallel."
+                 "multihost.initialize/shutdown (which also arm the "
+                 "CPU collective transport the localhost clusters "
+                 "need)")
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.distributed" \
+                        or a.name.startswith("jax.distributed."):
+                    emit("BLT110", node,
+                         "import of jax.distributed outside the blessed "
+                         "topology home; use bolt_tpu.parallel.multihost")
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and (node.module == "jax.distributed"
+                     or node.module.startswith("jax.distributed.")
+                     or (node.module == "jax"
+                         and any(a.name == "distributed"
+                                 for a in node.names))):
+            emit("BLT110", node,
+                 "import of jax.distributed outside the blessed "
+                 "topology home; use bolt_tpu.parallel.multihost")
 
         # ---- BLT109: os.kill / signal fault injection ------------------
         if isinstance(node, ast.Call) \

@@ -608,19 +608,46 @@ def _check_stream(arr, target, stages, diags):
     uploads, compiles or streams — each stage evaluates through the SAME
     ``stream._stage_apply`` body the per-slab executable traces."""
     from bolt_tpu import stream as _stream
+    from bolt_tpu.parallel import multihost as _mh
     src = arr._stream
     mesh = arr._mesh
     walk_split = src.split
     nslabs = -(-src.shape[0] // src.slab) if src.shape[0] else 0
     aval = jax.ShapeDtypeStruct(tuple(src.shape), src.dtype)
+    nproc = _mh.mesh_process_count(mesh)
+    note = ("out-of-core: ~%d slabs of %d records, prefetch depth %d, "
+            "uploader pool %d"
+            % (nslabs, src.slab, _stream.prefetch_depth(),
+               _stream.pool_size(src)))
+    if nproc > 1:
+        # the per-host plan (explain() shows it): each process produces
+        # and uploads only its shard of every slab; the cross-host fold
+        # is the slab program's mesh collective
+        note += ("; MULTI-PROCESS: %d hosts x ~%d records/slab each "
+                 "(per-process ingest, shard_map cross-host fold over "
+                 "axes %s)"
+                 % (nproc, src.slab // nproc,
+                    _mh.key_collective_axes(mesh, src.shape,
+                                            walk_split) or ("?",)))
     stages.append(Stage(
         0, "stream source (%s)" % src.kind, aval.shape,
         np.dtype(aval.dtype), walk_split,
-        _spec(mesh, aval.shape, walk_split),
-        note="out-of-core: ~%d slabs of %d records, prefetch depth %d, "
-             "uploader pool %d"
-             % (nslabs, src.slab, _stream.prefetch_depth(),
-                _stream.pool_size(src))))
+        _spec(mesh, aval.shape, walk_split), note=note))
+    if nproc > 1:
+        # BLT012: a slab whose record extent does not divide the
+        # key-axis device assignment has no per-process split — the
+        # executor refuses it with this same message
+        mh_err = _mh.slab_divisibility_error(
+            mesh, src.shape, walk_split,
+            src.slab_ranges() if src.kind == "callback" else [])
+        if mh_err is not None:
+            if mh_err.startswith("BLT012: "):
+                mh_err = mh_err[len("BLT012: "):]
+            diags.append(Diagnostic(
+                "BLT012", 0, mh_err,
+                hint="pick chunks= and key extents that are multiples "
+                     "of the key-axis device assignment; uneven tails "
+                     "cannot stream across processes"))
     _note_admission(_stream_slab_bytes(src), 0, diags)
     _note_resumable(src, 0, diags)
     idle_seen = _idle_device_check(mesh, aval.shape, walk_split, 0, diags,

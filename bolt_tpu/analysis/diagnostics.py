@@ -32,6 +32,9 @@ CODES = {
     "BLT011": ("warning",
                "one-shot iterator source under resumable(): resume "
                "impossible"),
+    "BLT012": ("error",
+               "streamed key axis does not divide the multi-process "
+               "topology"),
 }
 
 SEVERITIES = ("error", "warning", "info")

@@ -32,14 +32,14 @@ pointing at torn state — the interrupted checkpoint simply does not
 exist and the previous one still does.
 """
 
+import glob
 import json
 import os
 
 import numpy as np
 
-import jax
-
 from bolt_tpu import _chaos
+from bolt_tpu.parallel import multihost as _multihost
 
 
 def _array_path(path):
@@ -92,7 +92,7 @@ def save(path, barray, force=True):
         raise TypeError("checkpoint.save expects a mode='tpu' array; "
                         "got %r" % type(barray).__name__)
     use_orbax = _have_orbax()
-    if not use_orbax and jax.process_count() > 1:
+    if not use_orbax and _multihost.process_count() > 1:
         _orbax()                    # raises the pointed ImportError
     os.makedirs(path, exist_ok=True)
     if use_orbax:
@@ -109,7 +109,7 @@ def save(path, barray, force=True):
         with open(tmp, "wb") as f:       # np.save(path) would append
             np.save(f, host)             # ".npy" to the tmp name
         os.replace(tmp, _npy_path(path))
-    if jax.process_index() == 0:
+    if _multihost.process_index() == 0:
         # orbax coordinates per-shard ownership; the metadata file has one
         # writer so a shared checkpoint dir never sees interleaved writes
         meta = {"split": barray.split, "shape": list(barray.shape),
@@ -117,9 +117,7 @@ def save(path, barray, force=True):
                 "format": "orbax" if use_orbax else "npy"}
         with open(_meta_path(path), "w") as f:
             json.dump(meta, f)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("bolt_checkpoint_save")
+    _multihost.barrier("bolt_checkpoint_save")
 
 
 def load(path, context=None):
@@ -164,13 +162,28 @@ def load(path, context=None):
 # replaced first, meta second, both by atomic rename — a kill -9 at any
 # instant leaves either the previous complete checkpoint or the new
 # complete one, never a meta pointing at torn state.
+#
+# MULTI-PROCESS runs (bolt_tpu.parallel.multihost) extend the layout to
+# PER-PROCESS SHARD FILES with a RENDEZVOUS-CONSISTENT watermark:
+# process p writes <dir>/stream_state.p<p>.w<slabs>.npz (the watermark
+# is IN the name — old and new checkpoints coexist), every process
+# takes a barrier, and only then does process 0 replace the meta to
+# point at the new watermark; a second barrier fences the cleanup of
+# superseded shard files.  A kill -9 of the whole pod at ANY instant
+# therefore leaves a meta whose named watermark has a complete shard
+# file for EVERY process — the peers can never resume from different
+# watermarks (which would cross the collective fold).  The directory
+# must be shared storage (every pod checkpoint system's contract).
 
 _STATE_NAME = "stream_state.npz"
 _SMETA_NAME = "stream_meta.json"
 
 
-def _state_path(path):
-    return os.path.join(path, _STATE_NAME)
+def _state_path(path, pid=None, slabs=None):
+    if pid is None:
+        return os.path.join(path, _STATE_NAME)
+    return os.path.join(path, "stream_state.p%d.w%d.npz"
+                        % (int(pid), int(slabs)))
 
 
 def _smeta_path(path):
@@ -183,14 +196,17 @@ def _encode(obj, leaves):
     exact container), anything array-like lands in ``leaves`` by
     index.  Covers every accumulator shape the executor folds: bare
     sum/reduce/min/max partials, ``(n, mu, M2)`` moment triples, and
-    fused multi-stat component tuples."""
+    fused multi-stat component tuples.  Leaves are pulled through
+    ``multihost.local_value``: a pod run's fold partials are
+    P()-replicated global arrays, whose host copy is the local shard
+    (``np.asarray`` refuses the non-fully-addressable global)."""
     if obj is None:
         return None
     if isinstance(obj, list):
         return {"l": [_encode(x, leaves) for x in obj]}
     if isinstance(obj, tuple):
         return {"t": [_encode(x, leaves) for x in obj]}
-    leaves.append(np.asarray(obj))
+    leaves.append(_multihost.local_value(obj))
     return {"a": len(leaves) - 1}
 
 
@@ -204,16 +220,32 @@ def _decode(node, leaves):
     return leaves[node["a"]]
 
 
-def stream_save(path, fingerprint, slabs, records, state):
+def stream_save(path, fingerprint, slabs, records, state,
+                multiprocess=None):
     """Persist one streamed-run checkpoint: ``slabs`` retired slabs
     covering ``records`` records, with ``state`` the executor's folded
     partial accumulator (``(levels, pend)`` — device values are pulled
     to host here).  ``fingerprint`` identifies the logical run (source
     geometry + stage chain + terminal); :func:`stream_load` refuses a
     mismatch so a stale checkpoint can never seed a different pipeline.
-    Returns the state's byte count (the ``checkpoint_bytes`` tally)."""
+    Returns the state's byte count (the ``checkpoint_bytes`` tally).
+
+    On a MULTI-PROCESS run every peer calls this at the SAME watermark
+    (the executor checkpoints on a deterministic slab cadence): each
+    writes its own watermark-named shard file, a barrier proves all
+    landed, process 0 flips the meta, and a second barrier fences the
+    cleanup of superseded files — see the section comment above.
+    ``multiprocess`` says whether THIS run spans processes — the
+    executor passes its MESH's answer, because a process-local mesh
+    inside a multi-process runtime streams (and must checkpoint)
+    single-process: its peers are not at this watermark, and a barrier
+    here would hang them.  ``None`` falls back to the runtime query."""
     _chaos.hit("stream.checkpoint")
     os.makedirs(path, exist_ok=True)
+    if multiprocess is None:
+        multiprocess = _multihost.process_count() > 1
+    nproc = _multihost.process_count() if multiprocess else 1
+    pid = _multihost.process_index()
     leaves = []
     structure = _encode(state, leaves)
     arrays = {"leaf_%d" % i: leaf for i, leaf in enumerate(leaves)}
@@ -222,38 +254,75 @@ def stream_save(path, fingerprint, slabs, records, state):
     # without this cross-check a resume would fold the meta's (stale)
     # watermark onto the state's (newer) accumulator — double-counting
     # slabs silently.  stream_load refuses the pair on mismatch.
+    # (Multi-process files carry the watermark in their NAME instead:
+    # old and new checkpoints coexist and the meta selects one.)
     arrays["watermark"] = np.asarray([int(slabs), int(records)],
                                      dtype=np.int64)
-    tmp = _state_path(path) + ".tmp"
+    spath = _state_path(path) if nproc == 1 \
+        else _state_path(path, pid, slabs)
+    tmp = spath + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
-    os.replace(tmp, _state_path(path))
+    os.replace(tmp, spath)
+    if nproc > 1:
+        # every peer's shard file for THIS watermark exists past here —
+        # only then may the meta name it
+        _multihost.barrier("bolt_stream_ckpt_w%d" % int(slabs))
     meta = {"fingerprint": list(fingerprint), "slabs": int(slabs),
             "records": int(records), "structure": structure,
-            "leaves": len(leaves)}
-    tmp = _smeta_path(path) + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, _smeta_path(path))
+            "leaves": len(leaves), "nproc": nproc}
+    # single-process checkpoints are written by WHOEVER streams them —
+    # a process-local mesh may live on a non-zero runtime process; only
+    # the pod format elects process 0 as the one meta writer
+    if nproc == 1 or pid == 0:
+        tmp = _smeta_path(path) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, _smeta_path(path))
+    if nproc > 1:
+        # fence the cleanup: superseded shard files may vanish only
+        # once the meta durably points at the new watermark everywhere
+        _multihost.barrier("bolt_stream_ckpt_meta_w%d" % int(slabs))
+        for old in glob.glob(os.path.join(
+                path, "stream_state.p%d.w*.npz" % pid)):
+            if old != spath:
+                try:
+                    os.remove(old)
+                except FileNotFoundError:
+                    pass
     return sum(int(leaf.nbytes) for leaf in leaves)
 
 
-def stream_load(path, fingerprint):
+def stream_load(path, fingerprint, multiprocess=None):
     """Load a streamed-run checkpoint written by :func:`stream_save`:
     ``(slabs, records, state)`` with host-array leaves, or ``None``
     when no checkpoint exists, its fingerprint names a DIFFERENT
     logical run (shape/stages/terminal drifted — resuming would be
     silently wrong, so the stale checkpoint is ignored), or the meta
     and state files disagree on the watermark (a kill landed between
-    the two renames: the torn pair is discarded, never resumed)."""
+    the two renames: the torn pair is discarded, never resumed).
+
+    A multi-process run loads the SHARED meta (so every peer agrees on
+    the watermark) and this process's own shard file for that
+    watermark; a checkpoint cut by a different process count is
+    refused — a resumed pod must match the topology that wrote it.
+    ``multiprocess`` mirrors :func:`stream_save`'s (the executor passes
+    its mesh's answer; ``None`` = the runtime query)."""
     if not os.path.exists(_smeta_path(path)):
         return None
     with open(_smeta_path(path)) as f:
         meta = json.load(f)
     if list(meta.get("fingerprint", ())) != list(fingerprint):
         return None
+    if multiprocess is None:
+        multiprocess = _multihost.process_count() > 1
+    nproc = _multihost.process_count() if multiprocess else 1
+    if int(meta.get("nproc", 1)) != nproc:
+        return None                 # cut by a different pod topology
+    spath = _state_path(path) if nproc == 1 else _state_path(
+        path, _multihost.process_index(), int(meta["slabs"]))
     try:
-        with np.load(_state_path(path)) as z:
+        with np.load(spath) as z:
             wm = z["watermark"]
             leaves = [z["leaf_%d" % i]
                       for i in range(int(meta["leaves"]))]
@@ -266,12 +335,34 @@ def stream_load(path, fingerprint):
     return int(meta["slabs"]), int(meta["records"]), state
 
 
-def stream_clear(path):
+def stream_clear(path, multiprocess=None):
     """Remove a directory's stream checkpoint (the success path: a
     finished run must leave NO stale checkpoint behind — the
     ``bench_all --check`` gate asserts it).  Meta first, then state —
     the reverse of the write order, so an interrupted clear also never
-    leaves meta pointing at missing state."""
+    leaves meta pointing at missing state.  Multi-process (same
+    ``multiprocess`` contract as :func:`stream_save` — the executor
+    passes its mesh's answer): a barrier proves every peer reached
+    success, process 0 removes the meta, a second barrier fences it,
+    then each peer removes its own shard files."""
+    if multiprocess is None:
+        multiprocess = _multihost.process_count() > 1
+    if multiprocess:
+        _multihost.barrier("bolt_stream_clear")
+        if _multihost.process_index() == 0:
+            try:
+                os.remove(_smeta_path(path))
+            except FileNotFoundError:
+                pass
+        _multihost.barrier("bolt_stream_clear_meta")
+        for p in glob.glob(os.path.join(
+                path, "stream_state.p%d.w*.npz"
+                % _multihost.process_index())):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+        return
     for p in (_smeta_path(path), _state_path(path)):
         try:
             os.remove(p)

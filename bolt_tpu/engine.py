@@ -98,6 +98,9 @@ _SCHEMA = {
     "donations": 0,           # terminal buffer donations granted
     "persistent_hits": 0,     # XLA compiles served from the on-disk cache
     "persistent_misses": 0,   # XLA compiles that had to run for real
+    "persistent_warm_hits": 0,  # persistent hits while a warm_start()
+                                # fleet-preload is armed (serve.Server
+                                # start_warm= — the no-compile-storm proof)
     "diagnostics": 0,         # findings emitted by bolt_tpu.analysis.check
     "strict_checks": 0,       # pre-dispatch checks forced by analysis.strict
     "strict_rejections": 0,   # dispatches refused on error-severity findings
@@ -230,7 +233,11 @@ def _hook_persistent_monitoring():
 
         def listen(event, **kwargs):
             if event == "/jax/compilation_cache/cache_hits":
-                _COUNTERS.add("persistent_hits")
+                if _WARM_ARMED:
+                    _COUNTERS.update(persistent_hits=1,
+                                     persistent_warm_hits=1)
+                else:
+                    _COUNTERS.add("persistent_hits")
             elif event == "/jax/compilation_cache/cache_misses":
                 _COUNTERS.add("persistent_misses")
 
@@ -290,9 +297,13 @@ def persistent_cache(cache_dir=None, enable=True):
     individually cheap; the default floors would skip most of them).
 
     ``enable=False`` detaches the directory (in-memory caching only).
-    Returns the resolved directory (or ``None`` when disabling)."""
-    global _PERSISTENT_DIR
+    Returns the resolved directory (or ``None`` when disabling).  Any
+    explicit call here also DISARMS a prior :func:`warm_start` — hits
+    against a re-attached ordinary cache must not keep counting as
+    warm-start hits (``warm_start`` re-arms after delegating)."""
+    global _PERSISTENT_DIR, _WARM_ARMED
     _hook_persistent_monitoring()
+    _WARM_ARMED = False
     if not enable:
         jax.config.update("jax_compilation_cache_dir", None)
         _reset_jax_cache_singleton()
@@ -329,6 +340,37 @@ def _reset_jax_cache_singleton():
 def persistent_cache_dir():
     """The active on-disk cache directory, or ``None``."""
     return _PERSISTENT_DIR
+
+
+# fleet-warm start (serve.Server(start_warm=dir)): while armed, every
+# persistent-cache hit ALSO tallies persistent_warm_hits — the proof a
+# fresh process served its first requests from pre-seeded executables
+# instead of paying a compile storm
+_WARM_ARMED = False
+
+
+def warm_start(cache_dir):
+    """Arm the fleet-warm start: attach the on-disk XLA cache at
+    ``cache_dir`` (pre-seeded by an earlier process running the fleet's
+    pipeline shapes) and count every compile it serves as a
+    ``persistent_warm_hits`` — a warmed process's first request then
+    re-lowers but runs ZERO fresh XLA compiles (``persistent_misses``
+    stays flat).  Returns the resolved cache directory.
+    ``serve.Server(start_warm=dir)`` calls this at startup and
+    :func:`disarm_warm_start` when it closes, so the warm tally covers
+    the warmed server's lifetime, not every later cache hit."""
+    global _WARM_ARMED
+    out = persistent_cache(cache_dir)
+    _WARM_ARMED = True
+    return out
+
+
+def disarm_warm_start():
+    """Stop counting persistent hits as warm-start hits (the cache
+    itself stays attached — sharing compiled artifacts is still the
+    point; only the METRIC arming ends)."""
+    global _WARM_ARMED
+    _WARM_ARMED = False
 
 
 # ---------------------------------------------------------------------
@@ -501,7 +543,27 @@ def record_stream(chunks, ingest_s, compute_s, wall_s, overlap_s, depth,
 # overlaps), so all device queues observe one global program order and
 # the rendezvous always completes.  Measured µs-scale per launch; the
 # slow paths (lower/compile) run OUTSIDE it.
+#
+# MULTI-PROCESS scope (bolt_tpu.parallel.multihost): the lock is
+# PER-PROCESS — it cannot order enqueues across hosts.  Cross-process
+# collective order is instead safe BY CONSTRUCTION for the programs
+# that span hosts: the streaming executor's shard_map slab programs
+# dispatch in slab order on every process (the re-sequencer delivers
+# slabs strictly in order, and the slab schedule is a deterministic
+# function of the source geometry), and multihost.barrier() takes this
+# lock so a checkpoint rendezvous cannot interleave with a concurrent
+# tenant's enqueue within the process.  Running MULTIPLE tenants with
+# cross-host collectives concurrently would need a cross-process order
+# agreement on top — not provided yet (ROADMAP item 2 remainder).
 _ORDER_LOCK = threading.RLock()
+
+
+def order_lock():
+    """The process-wide dispatch-order lock, for the few seams outside
+    this module that enqueue collective programs of their own
+    (``multihost.barrier``'s rendezvous) — taking it keeps every
+    per-device queue observing ONE program order per process."""
+    return _ORDER_LOCK
 
 
 def _leaf_sig(x):

@@ -96,7 +96,7 @@ def rand(shape, context=None, axis=(0,), mode=None, dtype=None, seed=0):
 
 
 def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None,
-                 chunks=None, checkpoint=None):
+                 chunks=None, checkpoint=None, per_process=False):
     """Build a bolt array by calling ``fn(index_slices) -> block`` per
     index range — the sharded data-loader (extension beyond the reference
     factory, whose ``sc.parallelize`` scatter needs the full array at the
@@ -106,13 +106,18 @@ def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None,
     materialise one call per device shard; ``chunks`` sets records per
     streamed slab; ``checkpoint=dir`` makes every streamed run over the
     source RESUMABLE (slab-level fold checkpoints — see
-    ``stream.resumable``).  Local mode: one call for the whole array."""
+    ``stream.resumable``); ``per_process=True`` opts a MULTI-PROCESS
+    mesh into the pod-scale streaming contract (each host's loader is
+    invoked only for its own shard of each slab; the cross-host fold
+    runs as mesh collectives — ``bolt_tpu.parallel.multihost``).
+    Local mode: one call for the whole array."""
     cls = _lookup(context=context, mode=mode)
     if cls is ConstructLocal:
         return ConstructLocal.fromcallback(fn, shape, axis=axis, dtype=dtype)
     return ConstructTPU.fromcallback(fn, shape, context=context, axis=axis,
                                      dtype=dtype, chunks=chunks,
-                                     checkpoint=checkpoint)
+                                     checkpoint=checkpoint,
+                                     per_process=per_process)
 
 
 def fromiter(blocks, shape, context=None, axis=(0,), mode=None, dtype=None,

@@ -561,10 +561,11 @@ def _unique_sharded(b, return_counts):
     non-NamedSharding, or shards too big for their local sort transient.
     """
     from jax.sharding import NamedSharding, PartitionSpec
+    from bolt_tpu.parallel import multihost as _mh
     from bolt_tpu.tpu.array import _CHUNK_MAX_BYTES, _cached_jit
     # cheap gates FIRST — they must not materialise a deferred chain
     # just to decline (single-device / multi-process layouts)
-    if b.mesh is None or b.mesh.size <= 1 or jax.process_count() > 1:
+    if b.mesh is None or b.mesh.size <= 1 or _mh.process_count() > 1:
         return None
     data = b._data                          # chain materialises once
     sharding = data.sharding

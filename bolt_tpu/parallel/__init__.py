@@ -1,9 +1,10 @@
+from bolt_tpu.parallel import multihost
 from bolt_tpu.parallel.halo import exchange_halo
 from bolt_tpu.parallel.mesh import (default_mesh, ensure_auto,
                                     initialize_distributed, make_mesh)
 from bolt_tpu.parallel.sharding import (combined_spec, key_sharding,
                                         key_spec, reshard, spec_names)
 
-__all__ = ["default_mesh", "ensure_auto", "make_mesh",
+__all__ = ["default_mesh", "ensure_auto", "make_mesh", "multihost",
            "initialize_distributed", "combined_spec", "key_spec", "spec_names",
            "key_sharding", "reshard", "exchange_halo"]

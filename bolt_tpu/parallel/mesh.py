@@ -60,13 +60,12 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     running single-process.
 
     Replaces the reference's reliance on the Spark cluster manager for
-    multi-node bring-up (SURVEY.md §2.5).
+    multi-node bring-up (SURVEY.md §2.5).  Thin alias of
+    :func:`bolt_tpu.parallel.multihost.initialize` — the bootstrap (and
+    every other ``jax.distributed`` / process-topology touch, lint rule
+    BLT110) lives there.
     """
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
-    except (RuntimeError, ValueError):
-        # already initialised, or single-process run
-        pass
+    from bolt_tpu.parallel import multihost
+    multihost.initialize(coordinator_address=coordinator_address,
+                         num_processes=num_processes,
+                         process_id=process_id)

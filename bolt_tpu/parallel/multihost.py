@@ -1,0 +1,307 @@
+"""Multi-process (pod-scale) execution: the ``jax.distributed``
+bootstrap and the per-process topology/ingest helpers.
+
+Everything below this module keeps the single-controller programming
+model — one global mesh, one logical array, collectives inserted from
+sharding specs — but a POD is many OS processes, each owning a slice of
+the devices.  This module is the ONE place that knows about that
+(lint rule BLT110: ``jax.distributed`` / ``jax.process_index`` /
+``jax.process_count`` live here and in ``_compat.py`` only):
+
+* :func:`initialize` / :func:`shutdown` — bring up (and tear down) the
+  distributed runtime.  On CPU backends the cross-process collective
+  transport (gloo) is armed first; without it a multi-process program
+  fails at dispatch with XLA's "Multiprocess computations aren't
+  implemented on the CPU backend" — exactly what the localhost test
+  clusters would otherwise hit.
+* :func:`process_index` / :func:`process_count` /
+  :func:`is_multiprocess` / :func:`mesh_process_count` — topology
+  queries every other module routes through here.
+* :func:`local_slab_spec` — the per-process INGEST contract of the
+  streaming executor (``bolt_tpu.stream``): for a global slab of
+  records, which contiguous sub-range of the leading key axis THIS
+  process produces and uploads.  Each host touches only its own shard
+  of each slab; the global ``jax.Array`` is assembled from the local
+  parts (``jax.make_array_from_single_device_arrays``) with no
+  cross-host data motion at ingest time.
+* :func:`slab_divisibility_error` — the BLT012 rule: every slab's
+  record extent must divide the key-axis device assignment, or the
+  per-process split does not exist (the analysis checker emits the
+  same message as a ``BLT012`` diagnostic; the executor refuses with
+  it before any thread starts).
+* :func:`barrier` — a named cross-process rendezvous
+  (``multihost_utils.sync_global_devices``) taken under the engine's
+  dispatch-order lock, so a barrier collective can never interleave
+  with another thread's program enqueue inside one process.
+* :func:`local_value` — the host view of a replicated global array
+  (``np.asarray`` refuses non-fully-addressable arrays; every process
+  holds a full copy of a ``P()``-replicated value in its own shards).
+"""
+
+import numpy as np
+
+import jax
+
+# ---------------------------------------------------------------------
+# bootstrap / teardown
+# ---------------------------------------------------------------------
+
+_INITIALIZED = False
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None):
+    """Bootstrap the multi-process runtime (DCN / localhost cluster).
+
+    ::
+
+        multihost.initialize("10.0.0.1:8476", num_processes=4,
+                             process_id=rank)
+
+    Call BEFORE any backend query (device listing, array construction).
+    On CPU the gloo collective transport is configured first — the
+    2-process localhost test clusters run real cross-process programs
+    through it.  Idempotent: returns ``True`` when this call initialised
+    the runtime, ``False`` when it was already up (or the runtime
+    declined — a plain single-process run)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return False
+    try:
+        # without a cross-process collective implementation the CPU
+        # backend compiles single-process only; flag spelling is
+        # version-sensitive, so probe quietly
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except (RuntimeError, ValueError):
+        # already initialised elsewhere, or a single-process run
+        return False
+    _INITIALIZED = True
+    return True
+
+
+def shutdown():
+    """Tear down a runtime :func:`initialize` brought up (no-op
+    otherwise — a runtime initialised elsewhere is not ours to stop)."""
+    global _INITIALIZED
+    if not _INITIALIZED:
+        return False
+    try:
+        jax.distributed.shutdown()
+    except (RuntimeError, ValueError):
+        pass
+    _INITIALIZED = False
+    return True
+
+
+def is_initialized():
+    """Did :func:`initialize` bring up the distributed runtime?"""
+    return _INITIALIZED
+
+
+# ---------------------------------------------------------------------
+# topology queries (the BLT110 home)
+# ---------------------------------------------------------------------
+
+def process_index():
+    """This process's index in the cluster (0 single-process)."""
+    return jax.process_index()
+
+
+def process_count():
+    """Total processes in the cluster (1 single-process)."""
+    return jax.process_count()
+
+
+def is_multiprocess(mesh=None):
+    """Does ``mesh`` (or, with no mesh, the runtime) span more than one
+    process?"""
+    if mesh is None:
+        return process_count() > 1
+    return mesh_process_count(mesh) > 1
+
+
+def mesh_process_count(mesh):
+    """Number of DISTINCT processes owning ``mesh``'s devices."""
+    if mesh is None:
+        return 1
+    return len({d.process_index for d in np.asarray(mesh.devices).flat})
+
+
+def topology_token():
+    """Hashable process-topology component for engine program keys:
+    multi-process slab programs (shard_map + collectives) must never
+    share a cache entry with their single-process twins, and the token
+    records the pod width the program was compiled for."""
+    n = process_count()
+    return ("mh", n) if n > 1 else None
+
+
+def local_value(x):
+    """Host ``np.ndarray`` view of ``x``'s locally-addressable data.
+
+    A ``P()``-replicated global array (every cross-host fold partial the
+    streaming executor produces) holds one full copy per device;
+    ``np.asarray`` refuses the non-fully-addressable global, so the view
+    comes from the first addressable shard.  Fully-addressable arrays
+    (and plain host values) pass straight through ``np.asarray``."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return np.asarray(x.addressable_shards[0].data)
+    return np.asarray(x)
+
+
+def barrier(name):
+    """Named cross-process rendezvous (no-op single-process).
+
+    Taken under the engine's dispatch-order lock: the barrier is a
+    collective program, and a second thread enqueueing another program
+    mid-barrier would interleave the per-device queues — the exact
+    deadlock the order lock exists to prevent."""
+    if process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    from bolt_tpu import engine as _engine
+    with _engine.order_lock():
+        multihost_utils.sync_global_devices(str(name))
+
+
+# ---------------------------------------------------------------------
+# the per-process ingest contract (bolt_tpu.stream)
+# ---------------------------------------------------------------------
+
+class LocalSlabSpec:
+    """The per-process slab contract for one streamed source geometry:
+    which contiguous sub-range of each slab's leading key axis THIS
+    process produces and uploads.  Built by :func:`local_slab_spec`;
+    consumed by the streaming executor's uploader pool.
+
+    ``local_range(lo, hi)`` maps a global slab ``[lo, hi)`` to the
+    process-local ``[llo, lhi)`` in GLOBAL record coordinates — the
+    slices a ``fromcallback(..., per_process=True)`` loader is invoked
+    with.  Raises the pointed BLT012 error when the slab extent does
+    not divide the key-axis device assignment (no per-process split
+    exists)."""
+
+    __slots__ = ("mesh", "shape", "split", "pid", "nproc", "_cache")
+
+    def __init__(self, mesh, shape, split):
+        self.mesh = mesh
+        self.shape = tuple(int(s) for s in shape)
+        self.split = int(split)
+        self.pid = process_index()
+        self.nproc = mesh_process_count(mesh)
+        self._cache = {}
+
+    def slab_shape(self, lo, hi):
+        return (hi - lo,) + self.shape[1:]
+
+    def local_range(self, lo, hi):
+        """Global-coordinate ``[llo, lhi)`` of slab ``[lo, hi)`` this
+        process ingests (identity when the mesh is single-process)."""
+        llo, lhi = self._local_box(hi - lo)
+        return lo + llo, lo + lhi
+
+    def _local_box(self, nrec):
+        """Per-slab-length local axis-0 range ``(llo, lhi)`` RELATIVE to
+        the slab, derived from the key sharding's addressable-device
+        index map — contiguity and coverage are verified, so a mesh
+        whose process boundary does not fall on the leading key axis is
+        refused instead of silently mis-ingested."""
+        got = self._cache.get(nrec)
+        if got is not None:
+            return got
+        if self.nproc <= 1:
+            out = (0, nrec)
+            self._cache[nrec] = out
+            return out
+        err = slab_divisibility_error(self.mesh, self.shape, self.split,
+                                      [(0, nrec)])
+        if err is not None:
+            raise ValueError(err)
+        from bolt_tpu.parallel.sharding import key_sharding
+        shape = (nrec,) + self.shape[1:]
+        sharding = key_sharding(self.mesh, shape, self.split)
+        items = sharding.addressable_devices_indices_map(shape)
+        # DEDUPED boxes: a mesh axis that does not shard the slab
+        # replicates it, so several local devices hold the SAME region
+        # — replicas are a placement detail, not coverage (the same
+        # dedup _materialize_base and _gather_multihost apply)
+        boxes = {tuple(s.indices(n)[:2] for s, n in zip(idx, shape))
+                 for idx in items.values()}
+        llo = min(b[0][0] for b in boxes)
+        lhi = max(b[0][1] for b in boxes)
+        vol = sum(int(np.prod([hi0 - lo0 for lo0, hi0 in b]))
+                  for b in boxes)
+        want = (lhi - llo) * int(np.prod(self.shape[1:], dtype=np.int64)) \
+            if len(self.shape) > 1 else (lhi - llo)
+        if vol != want:
+            raise ValueError(
+                "multi-process streaming needs the process boundary on "
+                "the leading key axis: this mesh scatters process %d's "
+                "devices across a non-contiguous region of a %d-record "
+                "slab; use a mesh whose leading axis spans the "
+                "processes in order" % (self.pid, nrec))
+        out = (llo, lhi)
+        self._cache[nrec] = out
+        return out
+
+
+def local_slab_spec(mesh, shape=None, split=None):
+    """The :class:`LocalSlabSpec` for one streamed geometry.  Accepts
+    either ``(mesh, shape, split)`` or a single source-like object with
+    ``.mesh`` / ``.shape`` / ``.split`` attributes (a
+    ``stream.StreamSource``)."""
+    if shape is None and hasattr(mesh, "mesh"):
+        src = mesh
+        return LocalSlabSpec(src.mesh, src.shape, src.split)
+    return LocalSlabSpec(mesh, shape, split)
+
+
+def key_collective_axes(mesh, shape, split):
+    """Mesh-axis names the leading key axes shard over — the axes the
+    multi-process slab program's cross-host fold reduces with
+    (``psum``/``pmin``/``pmax``)."""
+    from bolt_tpu.parallel.sharding import key_spec, spec_names
+    spec = key_spec(mesh, shape, split)
+    return tuple(n for e in tuple(spec)[:split] for n in spec_names(e))
+
+
+def slab_divisibility_error(mesh, shape, split, ranges):
+    """The BLT012 rule, as one shared message (``analysis.check`` emits
+    it as a diagnostic; the streaming executor raises it): every slab's
+    leading extent must keep the SAME key-axis device assignment the
+    full shape has, or per-process sub-slabs do not exist for that slab
+    and the cross-host fold would silently double-count replicated
+    records.  Returns the message string, or ``None`` when every slab
+    in ``ranges`` divides."""
+    if mesh_process_count(mesh) <= 1:
+        return None
+    full_axes = key_collective_axes(mesh, shape, split)
+    if not full_axes:
+        width = int(np.prod([mesh.shape[n] for n in mesh.axis_names
+                             if mesh.shape[n] > 1], dtype=np.int64))
+        return ("BLT012: key axes %s do not divide the %d-device "
+                "multi-process mesh %s, so no per-process shard "
+                "assignment exists; choose key extents divisible by "
+                "the mesh axis sizes"
+                % (tuple(shape[:split]), width, dict(mesh.shape)))
+    for lo, hi in ranges:
+        slab_shape = (hi - lo,) + tuple(shape[1:])
+        axes = key_collective_axes(mesh, slab_shape, split)
+        if axes != full_axes:
+            width = int(np.prod([mesh.shape[n] for n in full_axes],
+                                dtype=np.int64))
+            return ("BLT012: slab [%d, %d) holds %d records, not "
+                    "divisible by the %d-way key-axis device assignment "
+                    "%s — the per-process ingest split does not exist "
+                    "for it; pick chunks= (records per slab) and a key "
+                    "extent that are multiples of %d, or pad the "
+                    "source (uneven tails cannot stream on a "
+                    "multi-process mesh)"
+                    % (lo, hi, hi - lo, width, full_axes, width))
+    return None

@@ -22,7 +22,16 @@ that lets N tenants share one process and one device mesh safely:
   tenant=...)`` returns a :class:`Future`; worker threads pop jobs
   round-robin across per-tenant queues, so one chatty tenant cannot
   starve the rest, while each tenant's own jobs run in submission
-  order;
+  order.  ``Server(weights={tenant: n})`` generalises the rotation to
+  a WEIGHTED fair share: the head tenant is served up to *n* queued
+  jobs (integer credits) per turn — default 1 keeps the plain
+  round-robin bit-for-bit, and any tenant with work is still served
+  within one rotation (starvation-free);
+* a **fleet-warm start**: ``Server(start_warm=dir)`` attaches a
+  pre-seeded ``engine.persistent_cache`` directory before the first
+  submit, so a fresh process serves its first request with ZERO fresh
+  XLA compiles (executables load from disk, counted as the engine's
+  ``persistent_warm_hits``);
 * **cross-tenant coalescing of identical executables**: the engine
   cache is keyed on program structure, and ``engine.get`` /
   ``_Dispatch`` now coalesce concurrent identical builds/compiles
@@ -417,7 +426,7 @@ class Server:
     full contract."""
 
     def __init__(self, workers=None, budget_bytes=None, queue_limit=None,
-                 policy="queue"):
+                 policy="queue", weights=None, start_warm=None):
         if policy not in ("queue", "reject"):
             raise ValueError("policy must be 'queue' or 'reject', got %r"
                              % (policy,))
@@ -426,6 +435,29 @@ class Server:
         self.queue_limit = int(queue_limit if queue_limit is not None
                                else _DEF_QUEUE)
         self.policy = policy
+        # weighted fair share: tenant -> integer credits per rotation.
+        # The scheduler serves up to weight(t) queued jobs from tenant t
+        # before moving to the next tenant with work; the default weight
+        # 1 keeps today's one-job-per-tenant round-robin bit-for-bit.
+        # The ring still guarantees starvation freedom: any tenant with
+        # queued work is served within one rotation (sum of weights).
+        self._weights = {}
+        if weights:
+            for t, w in dict(weights).items():
+                w = int(w)
+                if w < 1:
+                    raise ValueError(
+                        "tenant weight must be a positive integer, got "
+                        "%r for tenant %r" % (w, t))
+                self._weights[str(t)] = w
+        self._credits = {}             # tenant -> credits left this turn
+        # fleet-warm start (ROADMAP item 4 remainder): attach the
+        # pre-seeded on-disk XLA cache BEFORE the first submit, so a
+        # fresh process serves its first request without a compile
+        # storm; engine counter persistent_warm_hits is the proof
+        self.warm_dir = None
+        if start_warm is not None:
+            self.warm_dir = _engine.warm_start(start_warm)
         self.arbiter = DeviceArbiter(budget_bytes if budget_bytes
                                      is not None else _DEF_BUDGET)
         self._cond = threading.Condition()
@@ -543,23 +575,37 @@ class Server:
     # -- the worker loop -----------------------------------------------
 
     def _pop(self):
-        """Next job, round-robin across tenants (FIFO within one); None
-        once the server is draining and every queue is empty."""
+        """Next job, weighted round-robin across tenants (FIFO within
+        one); None once the server is draining and every queue is
+        empty.  A tenant at the head of the ring is served up to its
+        WEIGHT jobs (integer credits, default 1 — bit-for-bit the old
+        round-robin) before the rotation advances; credits reset each
+        time the tenant returns to the head, and a tenant whose queue
+        drains mid-turn forfeits the rest of its credits."""
         with self._cond:
             while True:
                 for _ in range(len(self._ring)):
                     t = self._ring[0]
-                    self._ring.rotate(-1)
                     q = self._queues.get(t)
-                    if q:
-                        item = q.popleft()
-                        if not q:
-                            del self._queues[t]
-                            self._ring.remove(t)
-                        self._depth -= 1
-                        self._g_depth.set(self._depth)
-                        self._cond.notify_all()
-                        return t, item
+                    if not q:
+                        self._ring.rotate(-1)
+                        continue
+                    item = q.popleft()
+                    credit = self._credits.pop(
+                        t, self._weights.get(t, 1)) - 1
+                    if not q:
+                        del self._queues[t]
+                        self._ring.remove(t)
+                    elif credit > 0:
+                        # weight left and work left: stay at the head
+                        # for the next pop
+                        self._credits[t] = credit
+                    else:
+                        self._ring.rotate(-1)
+                    self._depth -= 1
+                    self._g_depth.set(self._depth)
+                    self._cond.notify_all()
+                    return t, item
                 if self._stop.is_set():
                     return None
                 self._cond.wait(0.05)
@@ -692,6 +738,11 @@ class Server:
             self._cond.notify_all()
         for th in self._threads:
             th.join()
+        if self.warm_dir is not None:
+            # the warm tally covers THIS server's lifetime; the cache
+            # stays attached (artifacts keep serving), only the
+            # persistent_warm_hits arming ends
+            _engine.disarm_warm_start()
 
     def __enter__(self):
         return self
@@ -709,7 +760,7 @@ _ACTIVE_LOCK = threading.Lock()
 
 
 def start(workers=None, budget_bytes=None, queue_limit=None,
-          policy="queue"):
+          policy="queue", weights=None, start_warm=None):
     """Start and install THE process server (at most one may be active
     — the arbiter is only a global budget if there is one of it).
     Returns the :class:`Server`."""
@@ -720,7 +771,8 @@ def start(workers=None, budget_bytes=None, queue_limit=None,
                 "a serve.Server is already active; stop() it first "
                 "(the device-memory budget must have one owner)")
         _ACTIVE = Server(workers=workers, budget_bytes=budget_bytes,
-                         queue_limit=queue_limit, policy=policy)
+                         queue_limit=queue_limit, policy=policy,
+                         weights=weights, start_warm=start_warm)
         return _ACTIVE
 
 
@@ -761,7 +813,7 @@ def submit(pipeline, tenant="default", retries=0, deadline=None):
 
 @contextlib.contextmanager
 def serving(workers=None, budget_bytes=None, queue_limit=None,
-            policy="queue"):
+            policy="queue", weights=None, start_warm=None):
     """Scoped server lifetime::
 
         with bolt_tpu.serve.serving(workers=4) as sv:
@@ -769,9 +821,14 @@ def serving(workers=None, budget_bytes=None, queue_limit=None,
             out = fut.result()
 
     Drains and stops on clean exit; cancels queued jobs when the body
-    raised."""
+    raised.  ``weights={tenant: n}`` generalises the round-robin to a
+    weighted fair share (integer credits per rotation; default 1 keeps
+    the plain round-robin); ``start_warm=dir`` preloads the engine's
+    persistent-cache artifacts so a fresh process serves its first
+    request without a compile storm."""
     sv = start(workers=workers, budget_bytes=budget_bytes,
-               queue_limit=queue_limit, policy=policy)
+               queue_limit=queue_limit, policy=policy, weights=weights,
+               start_warm=start_warm)
     try:
         yield sv
     except BaseException:

@@ -85,6 +85,27 @@ Fault model (ISSUE 9 made it three-tiered):
   checkpoint (no stale files).  Deterministic fault points for all of
   this live in ``bolt_tpu._chaos`` (seams: ``stream.upload``,
   ``stream.dispatch``, ``stream.fold``, ``stream.checkpoint``).
+
+POD SCALE (``bolt_tpu.parallel.multihost``): on a mesh spanning
+PROCESSES this same executor runs as N peers over one deterministic
+slab schedule.  Each process produces and uploads ONLY its own
+contiguous shard of every slab (``multihost.local_slab_spec`` — the
+``fromcallback(..., per_process=True)`` contract; ``fromiter``
+re-iterable sources slice their shard out of each global block), the
+global slab array is glued from local parts with zero cross-host
+motion, and the slab program runs under ``shard_map`` with the
+cross-host fold as mesh-axis collectives (``psum`` for sum and the
+moment components, ``pmin``/``pmax`` for order statistics) — so one
+streamed slab costs one collective (two for moments) and every fold
+partial comes back replicated.  Slabs dispatch in slab order on every
+process, so the collective rendezvous can never cross; uneven slabs
+refuse with the pointed BLT012 error before any thread starts; and
+checkpoints become per-process shard files with a
+rendezvous-consistent watermark (``checkpoint.stream_save``).  On even
+splits the hierarchical sums equal the flat sums whenever the data
+keeps the reduction exact, so results stay bit-identical to the
+single-process run (tests/test_multihost.py proves it on a REAL
+2-process ``jax.distributed`` localhost cluster).
 """
 
 import contextlib
@@ -104,6 +125,7 @@ from bolt_tpu import _chaos
 from bolt_tpu import engine as _engine
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.obs.trace import clock as _clock
+from bolt_tpu.parallel import multihost as _multihost
 from bolt_tpu.utils import iter_record_blocks, prod
 
 # ---------------------------------------------------------------------
@@ -377,19 +399,43 @@ def _upload_slab(block, mesh, split):
     placement call serialising them.  Counted ONCE per slab (logical
     host bytes, like :func:`transfer` — replication is a placement
     detail, not payload), and every sub-block is blocked on before the
-    seconds are recorded, so ``transfer_seconds`` stays honest."""
+    seconds are recorded, so ``transfer_seconds`` stays honest.  The
+    degenerate case of :func:`_upload_slab_mh` — the local range is the
+    whole slab."""
+    return _upload_slab_mh(block, mesh, split, block.shape, 0)
+
+
+def _upload_slab_mh(block, mesh, split, slab_shape, axis0_off):
+    """Upload THIS PROCESS's sub-block of one slab and assemble the
+    global sharded array — the ONE uploader hot path (single-process
+    through :func:`_upload_slab`, pod-scale directly under the
+    ``bolt_tpu.parallel.multihost`` per-process contract).
+
+    ``block`` holds this process's contiguous record range of a slab of
+    ``slab_shape`` (the whole slab single-process); ``axis0_off`` is
+    that range's offset within the slab.  Parts are placed on the
+    process's ADDRESSABLE devices only (the index map never names
+    remote devices), and the global array is glued with
+    ``make_array_from_single_device_arrays`` — no cross-host data
+    motion happens at ingest; the cross-host combine is the slab
+    program's mesh collective.  Counted at the LOCAL bytes, so
+    ``transfer_bytes``/GB-per-second report each process's own link."""
     from bolt_tpu.parallel import sharding as _sh
     _chaos.hit("stream.upload")
     sp = _obs.begin("stream.transfer")
     t0 = _clock()
     try:
-        sharding, placements = _sh.device_placements(mesh, block.shape,
+        sharding, placements = _sh.device_placements(mesh, slab_shape,
                                                      split)
-        parts = [jax.device_put(block[index], dev)
-                 for dev, index in placements]
+        parts = []
+        for dev, index in placements:
+            lo0, hi0, _ = index[0].indices(slab_shape[0])
+            local = (slice(lo0 - axis0_off, hi0 - axis0_off),) \
+                + tuple(index[1:])
+            parts.append(jax.device_put(block[local], dev))
         for p in parts:
             p.block_until_ready()
-        out = _sh.assemble_from_parts(block.shape, sharding, parts)
+        out = _sh.assemble_from_parts(slab_shape, sharding, parts)
         nbytes = int(block.nbytes)
         _engine.record_transfer(nbytes, _clock() - t0)
         if sp is not None:
@@ -698,6 +744,12 @@ def stacked_map_stage(view, func, dtype):
     size = int(view._size)
     if st.dynamic or src.kind != "callback":
         return NotImplemented
+    if _multihost.mesh_process_count(src.mesh) > 1:
+        # a stacked func mixes records WITHIN its block; per-process
+        # shard boundaries would have to align with block boundaries on
+        # every host — fall back to materialising rather than reason
+        # about that geometry per process
+        return NotImplemented
     recs_per_slab = src.slab * prod(st.shape[1:st.split])
     if recs_per_slab % size != 0:
         return NotImplemented
@@ -745,6 +797,10 @@ def maybe_reduce(arr, func, axes, keepdims):
         return NotImplemented
     st = result_state(src)
     if st.pred is not None or st.n == 0:
+        return NotImplemented
+    if _multihost.mesh_process_count(src.mesh) > 1:
+        # a user combine function has no mesh collective: the cross-host
+        # fold cannot ride psum/pmin/pmax — materialise instead
         return NotImplemented
     if tuple(axes) != tuple(range(st.split)):
         return NotImplemented
@@ -802,25 +858,48 @@ _COMP_MERGE = {"sum": "sum", "min": "min", "max": "max",
                "moments": "moments"}
 
 
-def _terminal_partial(terminal, flat, mask, mfull, vshape, n, rfunc):
+def _terminal_partial(terminal, flat, mask, mfull, vshape, n, rfunc,
+                      axes=None):
     """Per-slab partial for ONE terminal over the flattened records —
     the exact expressions the standalone slab programs have always
     traced, factored out so the fused multi-stat slab program composes
     the SAME arithmetic per component (streamed-fused vs streamed-
-    standalone parity by construction)."""
+    standalone parity by construction).
+
+    ``axes`` is the MULTI-PROCESS hook: inside a shard_map'd slab
+    program ``flat`` is one device shard's records and ``axes`` names
+    the mesh axes the slab's key axes shard over — the reduction points
+    then insert the cross-host collective (``psum`` for sum and the
+    moment components, ``pmin``/``pmax`` for order statistics), so the
+    global partial leaves the program already combined across the pod:
+    one collective per slab for sum/min/max, two for moments (the
+    count+sum pair rides ONE fused psum; M2 needs the global mean
+    first).  The arithmetic is the single-process expression applied
+    hierarchically — sums of sums — so results match the one-process
+    run exactly whenever the data keeps the reduction exact (even
+    splits; the parity suite's contract)."""
     if terminal == "sum":
         # identity fold, exactly like _fused_filter_stat: dropped
         # records (NaNs included) become inert zeros
         v = flat if mfull is None else jnp.where(
             mfull, flat, jnp.asarray(0, flat.dtype))
-        return jnp.sum(v, axis=0)
+        s = jnp.sum(v, axis=0)
+        return jax.lax.psum(s, axes) if axes else s
     if terminal in ("min", "max"):
         # exact order statistics; a filter predicate never reaches here
         # (min/max multi-stat members are ineligible under a filter —
         # zero survivors would need the materialised error contract)
         op = jnp.min if terminal == "min" else jnp.max
-        return op(flat, axis=0)
+        p = op(flat, axis=0)
+        if axes:
+            p = jax.lax.pmin(p, axes) if terminal == "min" \
+                else jax.lax.pmax(p, axes)
+        return p
     if terminal == "reduce":
+        if axes:
+            raise ValueError(
+                "streamed reduce(func) cannot run on a multi-process "
+                "mesh: a user combine function has no mesh collective")
         vfunc = jax.vmap(rfunc)
         y = flat
         while y.shape[0] > 1:
@@ -845,17 +924,24 @@ def _terminal_partial(terminal, flat, mask, mfull, vshape, n, rfunc):
         cnt = jnp.sum(mask.astype(out_dt))
         xf = jnp.where(mfull, flat,
                        jnp.asarray(0, flat.dtype)).astype(out_dt)
+    sums = jnp.sum(xf, axis=0)
+    if axes:
+        # ONE fused collective for the pre-mean components: the global
+        # count and per-slot sum land together
+        cnt, sums = jax.lax.psum((cnt, sums), axes)
     safe = jnp.where(cnt > 0, cnt, jnp.asarray(1, out_dt))
-    mu = jnp.sum(xf, axis=0) / safe
+    mu = sums / safe
     dev = xf - mu
     if mfull is not None:
         dev = jnp.where(mfull, dev, jnp.asarray(0, out_dt))
     m2 = jnp.sum(dev * dev, axis=0)
+    if axes:
+        m2 = jax.lax.psum(m2, axes)
     return cnt, mu, m2
 
 
 def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
-                  comps=None):
+                  comps=None, sharded=False):
     """The ONE compiled program each slab runs: device-side stages +
     (masked) terminal partial, with the slab buffer DONATED so the ring
     recycles its memory.  ``fused=True`` is the level-0 fold fusion: the
@@ -866,8 +952,17 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
     the SAME single read of the slab — the streamed half of the fused
     multi-stat layer (bolt_tpu/tpu/multistat.py); each component traces
     the exact standalone expression via :func:`_terminal_partial`.
-    Engine-cached per (stages, terminal, slab geometry, fused, comps):
-    uniform slabs compile exactly once per variant."""
+
+    ``sharded=True`` is the POD form (``parallel.multihost``): the same
+    partial body runs under ``shard_map`` — each device computes its
+    shard's partial and the reduction points carry the cross-host
+    mesh-axis collective (see :func:`_terminal_partial`), so the
+    program's output is the ALREADY-GLOBAL pair partial, replicated on
+    every process (``out_specs=P()``).  The level-0 acc merge stays an
+    elementwise combine on replicated values outside the shard_map —
+    no extra collective.  Engine-cached per (stages, terminal, slab
+    geometry, fused, comps, process topology): uniform slabs compile
+    exactly once per variant PER PROCESS."""
     stages = source.stages
     pred = None
     if stages and stages[-1][0] == "filter":
@@ -877,10 +972,17 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
     mesh = source.mesh
     key = ("stream-slab-acc" if fused else "stream-slab", terminal,
            stages, pred, slab_shape, str(source.dtype), split, ddof,
-           rfunc, comps, mesh)
+           rfunc, comps, mesh,
+           _multihost.topology_token() if sharded else None)
 
     def build():
+        axes = _multihost.key_collective_axes(mesh, slab_shape, split) \
+            if sharded else None
+
         def partial(data):
+            # under shard_map ``data`` is ONE device shard; standalone it
+            # is the whole slab — the body is shape-polymorphic and the
+            # collective points in _terminal_partial close the gap
             from bolt_tpu.tpu.array import _pred_mask
             x = data
             for stg in stages:
@@ -895,19 +997,34 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
             if terminal == "multi":
                 return tuple(
                     _terminal_partial(c, flat, mask, mfull, vshape, n,
-                                      None)
+                                      None, axes=axes)
                     for c in comps)
             return _terminal_partial(
                 terminal if terminal in ("sum", "reduce") else "moments",
-                flat, mask, mfull, vshape, n, rfunc)
+                flat, mask, mfull, vshape, n, rfunc, axes=axes)
+
+        if sharded:
+            from jax.sharding import PartitionSpec
+            from bolt_tpu import _compat
+            from bolt_tpu.parallel.sharding import key_spec
+            # check_vma=False: the outputs ARE replicated (every leaf
+            # comes out of a psum/pmin/pmax over the sharding axes, and
+            # shards along non-participating axes compute from identical
+            # replicated inputs), but older runtimes' replication
+            # checker cannot always prove it through the staged bodies
+            body = _compat.shard_map(
+                partial, mesh, in_specs=key_spec(mesh, slab_shape, split),
+                out_specs=PartitionSpec(), check_vma=False)
+        else:
+            body = partial
 
         if not fused:
-            return jax.jit(partial, donate_argnums=(0,))
+            return jax.jit(body, donate_argnums=(0,))
 
         def run(data, acc):
             # level-0 fold fused in: acc (the EVEN slab's partial) merges
             # with this (ODD) slab's partial inside one dispatch
-            return _combine(terminal, rfunc, acc, partial(data),
+            return _combine(terminal, rfunc, acc, body(data),
                             comps=comps)
         return jax.jit(run, donate_argnums=(0, 1))
 
@@ -920,13 +1037,14 @@ def _merge_program(terminal, shape, dtype, rfunc, mesh):
     slab program traces)."""
     if terminal in ("sum", "reduce"):
         key = ("stream-merge", terminal, rfunc, tuple(shape), str(dtype),
-               mesh)
+               mesh, _multihost.topology_token())
 
         def build():
             return jax.jit(lambda a, b: _combine(terminal, rfunc, a, b))
         return _cached_jit(key, build)
 
-    key = ("stream-merge-moments", tuple(shape), str(dtype), mesh)
+    key = ("stream-merge-moments", tuple(shape), str(dtype), mesh,
+           _multihost.topology_token())
 
     def build():
         def merge(n1, mu1, m21, n2, mu2, m22):
@@ -940,7 +1058,8 @@ def _merge_multi_program(comps, sig, mesh):
     """Pairwise merge of two fused multi-stat partial TUPLES (pytree
     in, pytree out — one dispatch merges every component; ``sig`` is
     the flattened (shape, dtype) leaf signature for the cache key)."""
-    key = ("stream-merge-multi", comps, sig, mesh)
+    key = ("stream-merge-multi", comps, sig, mesh,
+           _multihost.topology_token())
 
     def build():
         return jax.jit(lambda a, b: _combine("multi", None, a, b,
@@ -950,7 +1069,8 @@ def _merge_multi_program(comps, sig, mesh):
 
 def _finalise_program(terminal, shape, dtype, ddof, mesh):
     """Moments triple → the requested statistic (engine-cached)."""
-    key = ("stream-final", terminal, tuple(shape), str(dtype), ddof, mesh)
+    key = ("stream-final", terminal, tuple(shape), str(dtype), ddof, mesh,
+           _multihost.topology_token())
 
     def build():
         nan = jnp.asarray(jnp.nan, dtype)
@@ -1266,6 +1386,23 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     split = source.split
     depth = prefetch_depth()
     nwork = pool_size(source)
+    # POD-SCALE run (parallel.multihost): the mesh spans processes, so
+    # this executor instance is one of N peers running the SAME slab
+    # schedule — each process produces and uploads only its own shard
+    # of each slab (mspec.local_range), the slab programs are
+    # shard_map'd with mesh-axis collectives doing the cross-host fold,
+    # and every fold partial comes back replicated.  Slab order is
+    # deterministic (the re-sequencer delivers strictly in order), so
+    # every process enqueues the collective programs identically — the
+    # rendezvous can never cross.
+    mspec = None
+    if _multihost.mesh_process_count(mesh) > 1:
+        err = _multihost.slab_divisibility_error(
+            mesh, source.shape, source.split,
+            source.slab_ranges() if source.kind == "callback" else [])
+        if err is not None:
+            raise ValueError(err)       # BLT012 — check() forecasts it
+        mspec = _multihost.local_slab_spec(source)
     # multi-tenant serving (bolt_tpu.serve): the run charges its slab
     # bytes to the process-wide device-memory arbiter — the ring's local
     # permit bound still applies, but N concurrent tenants now share one
@@ -1297,8 +1434,29 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     ck_fp = None
     if ck_dir is not None:
         from bolt_tpu import checkpoint as _ckptlib
+        if mspec is not None and \
+                _multihost.mesh_process_count(mesh) \
+                != _multihost.process_count():
+            # the checkpoint rendezvous (multihost.barrier) is a
+            # collective over the WHOLE runtime; a mesh spanning only a
+            # subset of the pod's processes would leave non-participants
+            # out of the barrier and hang the participants forever —
+            # refuse pointedly instead
+            raise ValueError(
+                "resumable checkpointing on a SUB-POD mesh is not "
+                "supported: this mesh spans %d of the runtime's %d "
+                "processes, and the checkpoint rendezvous barrier "
+                "covers the whole runtime.  Stream the checkpointed "
+                "run on a mesh covering every process (or drop "
+                "checkpoint=/resumable() for this sub-mesh run)"
+                % (_multihost.mesh_process_count(mesh),
+                   _multihost.process_count()))
         ck_fp = _run_fingerprint(source, terminal, ddof, rfunc, specs)
-        got_ck = _ckptlib.stream_load(ck_dir, ck_fp)
+        # the MESH's multiprocess answer, not the runtime's: a
+        # process-local mesh inside a multi-process runtime checkpoints
+        # single-process (its peers are elsewhere; a barrier would hang)
+        got_ck = _ckptlib.stream_load(ck_dir, ck_fp,
+                                      multiprocess=mspec is not None)
         if got_ck is not None:
             start_slab, resume_records, ck_state = got_ck
             _engine.record_stream_resume()
@@ -1354,9 +1512,14 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             for lo, hi in ranges:
                 if not _acquire(permits, stop):
                     return
-                if lease is not None and not lease.acquire(
-                        (hi - lo) * rec_bytes, stop=stop):
-                    return
+                if lease is not None:
+                    nrec = hi - lo
+                    if mspec is not None:
+                        llo, lhi = mspec.local_range(lo, hi)
+                        nrec = lhi - llo    # this process uploads only
+                        #                     its own shard's bytes
+                    if not lease.acquire(nrec * rec_bytes, stop=stop):
+                        return
                 jobq.put((i, lo, hi))
                 i += 1
             rsq.finish(i)
@@ -1400,8 +1563,18 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                                         attempt=attempt)
                         t0 = _clock()
                         try:
-                            block = source.produce_slab(lo, hi)
-                            buf = _upload_slab(block, mesh, split)
+                            if mspec is None:
+                                block = source.produce_slab(lo, hi)
+                                buf = _upload_slab(block, mesh, split)
+                            else:
+                                # per-process ingest contract: produce
+                                # and upload ONLY this host's shard of
+                                # the slab (global coordinates)
+                                llo, lhi = mspec.local_range(lo, hi)
+                                block = source.produce_slab(llo, lhi)
+                                buf = _upload_slab_mh(
+                                    block, mesh, split,
+                                    mspec.slab_shape(lo, hi), llo - lo)
                             tsec = _clock() - t0
                             if sp is not None:
                                 sp.set(bytes=int(block.nbytes), lo=lo,
@@ -1418,8 +1591,9 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         _obs.end(sp)
                         _act_exit()
                         break
-                    del block
-                    rsq.put(i, (buf, tsec, hi))
+                    bnb = int(block.nbytes)  # LOCAL bytes: what this
+                    del block                # process acquired/uploaded
+                    rsq.put(i, (buf, bnb, tsec, hi))
         except BaseException as exc:        # noqa: BLE001 — re-raised in
             rsq.fault(exc)                  # the consumer thread
 
@@ -1474,6 +1648,17 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                             sp = None
                             permits.release()  # unused hand-slot permit
                             break
+                        axis0_off = 0
+                        if mspec is not None:
+                            # per-process contract for iterator sources:
+                            # every process walks the SAME re-iterable
+                            # block sequence and uploads only its shard
+                            # slice of each global block (validated per
+                            # block — an indivisible slab raises the
+                            # pointed BLT012 error here)
+                            llo, lhi = mspec.local_range(lo, hi)
+                            axis0_off = llo - lo
+                            block = block[llo - lo:lhi - lo]
                         if lease is not None and not lease.acquire(
                                 int(block.nbytes), stop=stop):
                             return
@@ -1481,7 +1666,14 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         prev = None
                         while True:
                             try:
-                                buf = _upload_slab(block, mesh, split)
+                                if mspec is None:
+                                    buf = _upload_slab(block, mesh,
+                                                       split)
+                                else:
+                                    buf = _upload_slab_mh(
+                                        block, mesh, split,
+                                        mspec.slab_shape(lo, hi),
+                                        axis0_off)
                                 break
                             except BaseException as exc:  # noqa: BLE001
                                 # the block is in hand (an iterator
@@ -1496,8 +1688,9 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                     finally:
                         _obs.end(sp)
                         _act_exit()
+                    bnb = int(block.nbytes)
                     del block
-                    rsq.put(i, (buf, tsec, hi))
+                    rsq.put(i, (buf, bnb, tsec, hi))
                     i += 1
                 rsq.finish(i)
         except BaseException as exc:        # noqa: BLE001
@@ -1609,7 +1802,8 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
         try:
             jax.block_until_ready(state)
             nb = _ckptlib.stream_save(ck_dir, ck_fp, start_slab + nslabs,
-                                      done_records, state)
+                                      done_records, state,
+                                      multiprocess=mspec is not None)
             _engine.record_checkpoint(nb, _clock() - t0)
             if csp is not None:
                 csp.set(bytes=nb)
@@ -1626,8 +1820,11 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                                else None)
                 if got is None:
                     break
-                slab_i, (buf, tsec, slab_hi) = got
-                slab_bytes = int(buf.nbytes)
+                slab_i, (buf, slab_bytes, tsec, slab_hi) = got
+                # slab_bytes is the PROCESS-LOCAL upload size the worker
+                # acquired from the arbiter (== buf.nbytes single-process;
+                # this process's shard of it on a pod) — releases must
+                # mirror acquires or the serve budget drifts
                 ingest += tsec
                 t0 = _clock()
                 csp = _obs.begin("stream.compute",
@@ -1645,14 +1842,16 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         if pend is None:
                             prog = _slab_program(source, terminal,
                                                  buf.shape, ddof, rfunc,
-                                                 comps=comps)
+                                                 comps=comps,
+                                                 sharded=mspec is not None)
                             pend = prog(buf)
                             pend_bytes = slab_bytes
                         else:
                             # level-0 fold fused into the slab dispatch
                             prog = _slab_program(source, terminal,
                                                  buf.shape, ddof, rfunc,
-                                                 fused=True, comps=comps)
+                                                 fused=True, comps=comps,
+                                                 sharded=mspec is not None)
                             pairp = prog(buf, pend)
                             pend = None
                             _fold_push(pairp)
@@ -1696,8 +1895,12 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             # chaos-injected fault): persist the retired-slab watermark
             # FIRST, so the next run over this source resumes from here
             # instead of from the last periodic checkpoint — best
-            # effort, never masking the original exception
-            if ck_dir is not None and nslabs:
+            # effort, never masking the original exception.  NOT on a
+            # multi-process mesh: peers can fail at different
+            # watermarks, and the abort-time write has no rendezvous —
+            # only the periodic checkpoints (barrier-consistent across
+            # the pod) are trustworthy resume points there.
+            if ck_dir is not None and nslabs and mspec is None:
                 try:
                     _write_checkpoint()
                 except Exception:       # noqa: BLE001 — the original
@@ -1739,7 +1942,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             _obs.end(fsp)
         if ck_dir is not None:
             # success: a finished run leaves NO stale checkpoint behind
-            _ckptlib.stream_clear(ck_dir)
+            _ckptlib.stream_clear(ck_dir, multiprocess=mspec is not None)
         compute += _clock() - t0
         wall = _clock() - t_start
         overlap = max(0.0, ingest + compute - wall)
@@ -1853,5 +2056,27 @@ def _materialize_base(source):
     host = np.empty(shape, source.dtype)
     for lo, hi, block in source.slabs():
         host[lo:hi] = block
+    if _multihost.is_multiprocess(source.mesh):
+        # device_put cannot scatter a host array across processes —
+        # each process's devices pick their own shards out of the
+        # host-assembled copy (every process iterated the re-iterable
+        # source itself, so each holds the full array).  Counted at the
+        # LOCAL logical bytes (this process's distinct shard regions,
+        # replicas deduped), matching the per-process transfer contract
+        # of the streaming path.
+        t0 = _clock()
+        data = jax.make_array_from_callback(shape, sharding,
+                                            lambda idx: host[idx])
+        seen = set()
+        local = 0
+        for idx in sharding.addressable_devices_indices_map(
+                tuple(shape)).values():
+            box = tuple(s.indices(n)[:2] for s, n in zip(idx, shape))
+            if box not in seen:
+                seen.add(box)
+                local += prod([b - a for a, b in box])
+        _engine.record_transfer(local * source.dtype.itemsize,
+                                _clock() - t0)
+        return BoltArrayTPU(data, source.split, source.mesh)
     data = transfer(host, sharding)
     return BoltArrayTPU(data, source.split, source.mesh)

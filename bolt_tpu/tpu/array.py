@@ -3113,6 +3113,7 @@ class BoltArrayTPU(BoltArray):
         reference's ``sortByKey().collect()``) has no analog —
         collectives need every process participating."""
         from jax.experimental import multihost_utils
+        from bolt_tpu.parallel import multihost as _mh
         shape = tuple(data.shape)
         dtype = np.dtype(data.dtype)
         if out is None:
@@ -3120,7 +3121,7 @@ class BoltArrayTPU(BoltArray):
             # callers with less host RAM pass out= (e.g. a memmap) or
             # use iter_shards
             out = np.empty(shape, dtype)
-        pid = jax.process_index()
+        pid = _mh.process_index()
 
         def norm(idx):
             return tuple(s.indices(d)[:2] for s, d in zip(idx, shape))
@@ -3136,7 +3137,7 @@ class BoltArrayTPU(BoltArray):
             if key not in owners or dev.id < owners[key].id:
                 owners[key] = dev
             procs.setdefault(key, set()).add(dev.process_index)
-        nproc = jax.process_count()
+        nproc = _mh.process_count()
         stats = {"regions": 0, "broadcasts": 0, "max_piece_bytes": 0}
 
         # step 3: broadcast each non-universal region in bounded pieces

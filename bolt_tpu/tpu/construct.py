@@ -14,6 +14,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu.parallel import multihost as _multihost
 from bolt_tpu.parallel.mesh import default_mesh, ensure_auto
 from bolt_tpu.parallel.sharding import is_mesh, key_sharding
 from bolt_tpu.utils import inshape, tupleize
@@ -71,8 +72,7 @@ class ConstructTPU:
         rest = [i for i in range(a.ndim) if i not in axes]
         perm = axes + rest
         split = len(axes)
-        multihost = any(d.process_index != jax.process_index()
-                        for d in np.asarray(mesh.devices).flat)
+        multihost = _multihost.is_multiprocess(mesh)
 
         # device arrays stay on device: transpose/cast/reshard without a
         # host round-trip.  On a multi-host mesh this path also serves
@@ -196,7 +196,7 @@ class ConstructTPU:
 
     @staticmethod
     def fromcallback(fn, shape, context=None, axis=(0,), dtype=None,
-                     chunks=None, checkpoint=None):
+                     chunks=None, checkpoint=None, per_process=False):
         """Build a distributed array by calling ``fn`` per index range —
         the sharded data-loader slot.
 
@@ -225,16 +225,36 @@ class ConstructTPU:
         Note ``shape`` is interpreted key-axes-first (like
         ``ones``/``zeros``): ``axis`` names which of those axes are
         keys, and they are moved to the front before ``fn`` sees slices.
+
+        ``per_process=True`` opts into the MULTI-PROCESS ingest
+        contract (``bolt_tpu.parallel.multihost``): on a mesh spanning
+        processes, each host's streaming executor invokes ``fn`` only
+        for its own contiguous sub-range of each slab's leading key
+        axis and uploads only that shard — the pod-scale streaming
+        path, with the cross-host fold done by mesh-axis collectives
+        inside the slab program.  ``fn`` must therefore serve any index
+        range on any host (a shared filesystem / object-store reader).
+        Single-process meshes accept the flag as a no-op (local range =
+        the whole slab), so one loader runs unchanged from laptop to
+        pod.
         """
         from bolt_tpu.tpu.array import BoltArrayTPU
         explicit = dtype is not None
         mesh, shape, split, dtype, sharding = \
             ConstructTPU._device_build_spec(shape, context, axis, dtype)
-        multihost = any(d.process_index != jax.process_index()
-                        for d in np.asarray(mesh.devices).flat)
-        if explicit and not multihost:
+        multihost = _multihost.is_multiprocess(mesh)
+        if per_process and not explicit:
+            raise ValueError(
+                "fromcallback(per_process=True) requires an explicit "
+                "dtype: the per-process contract is a streaming plan, "
+                "and streaming sources record their element type up "
+                "front")
+        if explicit and (not multihost or per_process):
             # lazy streaming source; materialisation (stream.materialize)
-            # replays the per-shard upload below bit-identically
+            # replays the per-shard upload below bit-identically.  On a
+            # multi-process mesh this is the per_process=True contract:
+            # the executor invokes fn per host, for that host's shard of
+            # each slab only.
             from bolt_tpu import stream as _streamlib
             src = _streamlib.StreamSource.from_callback(
                 fn, shape, split, dtype, mesh, chunks=chunks,
@@ -276,6 +296,15 @@ class ConstructTPU:
         inferred up front).  Reduction terminals stream the iterator
         once through the out-of-core executor; materialising consumers
         assemble it on host first (needs host RAM for the full array).
+
+        On a MULTI-PROCESS mesh, RE-ITERABLE sources (a list of blocks,
+        an object with a fresh ``__iter__``) stream under the
+        per-process contract (``bolt_tpu.parallel.multihost``): every
+        process iterates its own copy of the iterable, slices out its
+        shard of each global block, and uploads only that — the
+        cross-host fold runs as mesh-axis collectives in the slab
+        program.  One-shot iterators (generators, cursors) are refused
+        with a pointed error below.
         """
         from bolt_tpu.tpu.array import BoltArrayTPU
         if dtype is None:
@@ -284,15 +313,24 @@ class ConstructTPU:
                 "lazily, so the element type cannot be inferred up front)")
         mesh, shape, split, dtype, _ = \
             ConstructTPU._device_build_spec(shape, context, axis, dtype)
-        if any(d.process_index != jax.process_index()
-               for d in np.asarray(mesh.devices).flat):
-            # a sequential host iterator cannot serve per-process shards
-            # (fromcallback's multihost path random-accesses by index)
+        if _multihost.is_multiprocess(mesh) \
+                and iter(blocks) is blocks:
+            # the BLT011 reasoning, terminally: a one-shot iterator dies
+            # with its process, so a killed run can never re-stream it
+            # (resume impossible) — and on a pod EVERY process must walk
+            # the block sequence to slice its own shard of each slab,
+            # which a single-consumption cursor cannot survive either:
+            # ingest is impossible too.
             raise ValueError(
-                "fromiter does not support multi-host meshes: blocks are "
-                "a sequential stream on ONE host; use fromcallback, whose "
-                "loader serves any index range, so each process can read "
-                "its own devices' shards")
+                "fromiter on a multi-process mesh requires a RE-ITERABLE "
+                "source (e.g. a list of blocks, or an object whose "
+                "__iter__ starts fresh): each process iterates its own "
+                "copy and uploads only its per-process shard of every "
+                "slab (bolt_tpu.parallel.multihost contract).  A "
+                "one-shot iterator cannot serve that — nor can a killed "
+                "run ever resume from it (the BLT011 rule: the iterator "
+                "dies with the process).  Use fromcallback("
+                "per_process=True) for random-access loaders")
         from bolt_tpu import stream as _streamlib
         src = _streamlib.StreamSource.from_iter(blocks, shape, split,
                                                 dtype, mesh,

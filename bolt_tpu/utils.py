@@ -458,3 +458,19 @@ def chain_retry_step(exc, prev, attempt, allowed, what, knob):
             "error is chained below, each attempt chained to the one "
             "before" % (what, attempt, knob)) from exc
     raise exc
+
+
+def load_script(name):
+    """Load ``scripts/<name>.py`` from this repo by path, WITHOUT
+    importing it as a package module (scripts are not a package, and
+    several — the multihost cluster harness, chaos_run — are shared by
+    tests, bench_all, perf_regress and examples alike).  One loader
+    instead of per-caller importlib boilerplate."""
+    import importlib.util
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "scripts", "%s.py" % name)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

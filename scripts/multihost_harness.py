@@ -1,0 +1,476 @@
+#!/usr/bin/env python
+"""Localhost multi-process cluster harness: a REAL ``jax.distributed``
+CPU cluster of N OS processes, for the pod-scale streaming suite.
+
+``run_cluster(payload, ...)`` spawns N workers (each owning
+``devs`` virtual CPU devices via ``--xla_force_host_platform_device_count``),
+joins them through ``bolt_tpu.parallel.multihost.initialize`` (which
+arms the gloo cross-process collective transport on CPU), runs the
+named payload in every process, and returns the per-process JSON
+results plus any ``.npy`` artifacts the payload saved.
+
+The harness is also the pod's FAULT REPORTER: when one worker dies
+(``kill -9``, an uncaught error) while its peers still run, the
+survivors would block forever inside the next cross-host collective —
+so the monitor terminates them and raises a POINTED ``RuntimeError``
+naming the dead process and its exit code.  ``expect_dead=True``
+(the checkpoint/resume kill tests) instead returns the exit codes.
+
+Used by tests/test_multihost.py, scripts/bench_all.py (config 11) and
+scripts/perf_regress.py (the ``multihost_stream`` family); run
+standalone as ``python scripts/multihost_harness.py`` for a smoke pass
+of the parity payload.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------
+# the parent side
+# ---------------------------------------------------------------------
+
+def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
+                worker_env=None, expect_dead=False, out_dir=None):
+    """Stand up an ``nproc``-process cluster and run ``payload`` in
+    every process.  Returns ``(results, out_dir, rcs)`` where
+    ``results`` is the list of per-process result dicts (``None`` for a
+    process that died) and ``rcs`` the exit codes.
+
+    ``env`` adds to every worker's environment; ``worker_env`` is a
+    ``{pid: {...}}`` per-worker overlay (how the fault tests arm
+    ``BOLT_CHAOS`` on ONE process).  With ``expect_dead=False`` a
+    worker death while peers still run raises the pointed
+    ``RuntimeError``."""
+    own_dir = out_dir is None
+    if own_dir:
+        out_dir = tempfile.mkdtemp(prefix="bolt-mh-")
+    base = dict(os.environ)
+    base.pop("BOLT_CHAOS", None)         # never inherit a stale arming
+    base.update({
+        "BOLT_MH_PAYLOAD": str(payload),
+        "BOLT_MH_NPROC": str(nproc),
+        "BOLT_MH_DEVS": str(devs),
+        "BOLT_MH_PORT": str(free_port()),
+        "BOLT_MH_OUT": out_dir,
+    })
+    if env:
+        base.update({k: str(v) for k, v in env.items()})
+    procs, logs = [], []
+    for pid in range(nproc):
+        e = dict(base)
+        if worker_env and pid in worker_env:
+            e.update({k: str(v) for k, v in worker_env[pid].items()})
+        log = open(os.path.join(out_dir, "worker.%d.log" % pid), "wb")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             str(pid)],
+            env=e, stdout=log, stderr=subprocess.STDOUT))
+    rcs = [None] * nproc
+    deadline = time.time() + timeout
+    try:
+        while any(rc is None for rc in rcs):
+            for pid, p in enumerate(procs):
+                if rcs[pid] is None:
+                    rcs[pid] = p.poll()
+            bad = [pid for pid, rc in enumerate(rcs)
+                   if rc is not None and rc != 0]
+            if bad and any(rc is None for rc in rcs):
+                # a peer is gone: survivors will block in the next
+                # cross-host collective forever.  Short grace (they may
+                # be dying of the same injected fault), then terminate
+                # and report POINTEDLY which process died.
+                grace = time.time() + 3.0
+                while time.time() < grace and any(
+                        p.poll() is None for p in procs):
+                    time.sleep(0.05)
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for pid, p in enumerate(procs):
+                    if rcs[pid] is None:
+                        rcs[pid] = p.wait()
+                if not expect_dead:
+                    dead = bad[0]
+                    raise RuntimeError(
+                        "multihost cluster: process %d died (exit code "
+                        "%s) before the run finished — its peers were "
+                        "blocked on the next cross-host collective and "
+                        "have been terminated; see %s"
+                        % (dead, rcs[dead],
+                           os.path.join(out_dir,
+                                        "worker.%d.log" % dead)))
+                break
+            if time.time() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                raise RuntimeError(
+                    "multihost cluster timed out after %ss (logs in %s)"
+                    % (timeout, out_dir))
+            time.sleep(0.05)
+        for pid, p in enumerate(procs):
+            if rcs[pid] is None:
+                rcs[pid] = p.wait()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+    results = []
+    for pid in range(nproc):
+        path = os.path.join(out_dir, "result.%d.json" % pid)
+        if os.path.exists(path):
+            with open(path) as f:
+                results.append(json.load(f))
+        else:
+            results.append(None)
+    if not expect_dead:
+        for pid, rc in enumerate(rcs):
+            if rc != 0 or results[pid] is None:
+                with open(os.path.join(out_dir, "worker.%d.log" % pid),
+                          "rb") as f:
+                    tail = f.read()[-4000:].decode(errors="replace")
+                raise RuntimeError(
+                    "multihost worker %d failed (rc=%s):\n%s"
+                    % (pid, rc, tail))
+    return results, out_dir, rcs
+
+
+# ---------------------------------------------------------------------
+# the worker side
+# ---------------------------------------------------------------------
+
+def _bootstrap(pid):
+    """Per-worker preamble: force the virtual CPU topology BEFORE any
+    backend query, then join the cluster through the blessed
+    multihost.initialize door (which arms gloo on CPU)."""
+    devs = int(os.environ["BOLT_MH_DEVS"])
+    nproc = int(os.environ["BOLT_MH_NPROC"])
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=%d" % devs)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, _REPO)
+    from bolt_tpu.parallel import multihost
+    if nproc > 1:
+        ok = multihost.initialize(
+            coordinator_address="127.0.0.1:%s" % os.environ["BOLT_MH_PORT"],
+            num_processes=nproc, process_id=pid)
+        assert ok, "multihost.initialize declined"
+    return multihost
+
+
+# user stage funcs at module level: bytecode-identical across processes
+# AND across runs, so program keys (and checkpoint fingerprints) match
+ADD1 = lambda v: v + 1  # noqa: E731
+
+
+def _mesh():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(jax.devices()), ("k",))
+
+
+def _crafted(n, vdim):
+    """Bit-exactness-crafted data: period-8 integer pattern (+ a half-
+    step per value slot).  Sums are exact in f32, every shard of a
+    multiple-of-8 record range has the SAME mean, so the hierarchical
+    (per-shard + collective) moments equal the single-process moments
+    BIT for bit — the same trick the crafted-Welford stream suite
+    uses."""
+    import numpy as np
+    r = np.arange(n, dtype=np.float32) % 8
+    v = np.arange(vdim, dtype=np.float32) * 0.5
+    return (r[:, None] + v[None, :]).astype(np.float32)
+
+
+def _value(barray):
+    """Host value of a (possibly replicated cross-process) result."""
+    from bolt_tpu.parallel import multihost
+    return multihost.local_value(barray._data)
+
+
+def payload_stream_parity(pid):
+    """The acceptance payload: streamed sum AND fused stats('sum','var')
+    over a per-process fromcallback source, with the compile-once,
+    zero-leaked-span, BLT012, fromiter and explain() proofs recorded."""
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import analysis, engine, obs
+    from bolt_tpu.parallel import multihost
+    out = os.environ["BOLT_MH_OUT"]
+    n = int(os.environ.get("BOLT_MH_NKEYS", "64"))
+    vdim = 8
+    chunks = int(os.environ.get("BOLT_MH_CHUNKS", "16"))
+    x = _crafted(n, vdim)
+    mesh = _mesh()
+    obs.clear()
+    obs.enable()
+    rows = []                       # list.append is thread-safe (the
+    #                                 uploader pool calls concurrently)
+
+    def loader(idx):
+        rows.append(len(range(*idx[0].indices(n))))
+        return x[idx]
+
+    def make():
+        return bolt.fromcallback(loader, (n, vdim), mesh,
+                                 dtype=np.float32, chunks=chunks,
+                                 per_process=True)
+
+    res = {"pid": pid, "nproc": multihost.process_count()}
+
+    # --- streamed sum: compile-once proof across TWO passes -----------
+    c0 = engine.counters()
+    s1 = make().map(ADD1).sum().cache()
+    c1 = engine.counters()
+    np.save(os.path.join(out, "sum.%d.npy" % pid), _value(s1))
+    make().map(ADD1).sum().cache()
+    c2 = engine.counters()
+    res["aot_first_pass"] = c1["aot_compiles"] - c0["aot_compiles"]
+    res["misses_first_pass"] = c1["misses"] - c0["misses"]
+    res["recompiles_second_pass"] = (
+        c2["aot_compiles"] - c1["aot_compiles"]
+        + c2["misses"] - c1["misses"])
+    res["transfer_bytes"] = c2["transfer_bytes"] - c0["transfer_bytes"]
+
+    # --- fused multi-stat: stats("sum", "var") one pass ---------------
+    st = make().map(ADD1).stats("sum", "var")
+    np.save(os.path.join(out, "stats_sum.%d.npy" % pid),
+            _value(st["sum"]))
+    np.save(os.path.join(out, "stats_var.%d.npy" % pid),
+            _value(st["var"]))
+
+    # --- per-process ingest contract: this process produced ONLY its
+    # own shard of every slab (3 passes x its fraction of the records)
+    res["rows_produced"] = sum(rows)
+    res["rows_expected"] = 3 * (n // multihost.process_count())
+
+    # --- the per-host plan in explain() -------------------------------
+    res["explain_multiprocess"] = (
+        "MULTI-PROCESS" in analysis.explain(make().map(ADD1))
+        if multihost.process_count() > 1 else True)
+
+    # --- BLT012: an indivisible slab refuses, and check() forecasts ---
+    bad = bolt.fromcallback(lambda idx: x[idx], (n, vdim), mesh,
+                            dtype=np.float32, chunks=3,
+                            per_process=True)
+    if multihost.process_count() > 1:
+        try:
+            bad.map(ADD1).sum().cache()
+            res["blt012_refused"] = False
+        except ValueError as exc:
+            res["blt012_refused"] = "BLT012" in str(exc)
+        res["blt012_forecast"] = analysis.check(
+            bad.map(ADD1)).has("BLT012")
+    else:
+        res["blt012_refused"] = res["blt012_forecast"] = True
+
+    # --- fromiter: re-iterable streams per process; one-shot refuses --
+    blocks = [x[i:i + chunks] for i in range(0, n, chunks)]
+    fi = bolt.fromiter(blocks, (n, vdim), mesh, dtype=np.float32)
+    np.save(os.path.join(out, "fromiter_sum.%d.npy" % pid),
+            _value(fi.map(ADD1).sum().cache()))
+
+    # --- a REPLICATING mesh axis: with >1 device per process, a 2-axis
+    # mesh whose second axis does not shard the key replicates each
+    # per-process shard across local devices — the local-box dedup and
+    # the psum-over-participating-axes-only paths must still fold
+    # exactly (key extent 6 keeps axis "b" unabsorbed)
+    import jax
+    if multihost.process_count() > 1 and len(jax.devices()) >= 4:
+        from jax.sharding import Mesh
+        dv = np.asarray(jax.devices()).reshape(
+            multihost.process_count(), -1)
+        mesh2 = Mesh(dv, ("a", "b"))
+        xq = (np.arange(6 * 4) % 4).astype(np.float32).reshape(6, 4)
+        srcq = bolt.fromcallback(lambda idx: xq[idx], (6, 4), mesh2,
+                                 dtype=np.float32, chunks=2,
+                                 per_process=True)
+        sq = _value(srcq.map(ADD1).sum().cache())
+        res["replicated_axis_ok"] = bool(
+            np.array_equal(sq, (xq + 1).sum(axis=0)))
+    if multihost.process_count() > 1:
+        try:
+            bolt.fromiter((b for b in blocks), (n, vdim), mesh,
+                          dtype=np.float32)
+            res["oneshot_refused"] = False
+        except ValueError as exc:
+            res["oneshot_refused"] = "one-shot" in str(exc).lower() \
+                or "RE-ITERABLE" in str(exc)
+    else:
+        res["oneshot_refused"] = True
+
+    res["leaked_spans"] = obs.active_count()
+    obs.disable()
+    return res
+
+
+def payload_single_ref(pid):
+    """The single-process reference: identical data and pipelines on a
+    one-process mesh of the SAME total device count — the bit-identity
+    baseline the 2-process run is compared against."""
+    import numpy as np
+    import bolt_tpu as bolt
+    out = os.environ["BOLT_MH_OUT"]
+    n = int(os.environ.get("BOLT_MH_NKEYS", "64"))
+    vdim = 8
+    chunks = int(os.environ.get("BOLT_MH_CHUNKS", "16"))
+    x = _crafted(n, vdim)
+    mesh = _mesh()
+
+    def make():
+        return bolt.fromcallback(lambda idx: x[idx], (n, vdim), mesh,
+                                 dtype=np.float32, chunks=chunks,
+                                 per_process=True)
+
+    np.save(os.path.join(out, "ref_sum.npy"),
+            _value(make().map(ADD1).sum().cache()))
+    st = make().map(ADD1).stats("sum", "var")
+    np.save(os.path.join(out, "ref_stats_sum.npy"), _value(st["sum"]))
+    np.save(os.path.join(out, "ref_stats_var.npy"), _value(st["var"]))
+    blocks = [x[i:i + chunks] for i in range(0, n, chunks)]
+    fi = bolt.fromiter(blocks, (n, vdim), mesh, dtype=np.float32)
+    np.save(os.path.join(out, "ref_fromiter_sum.npy"),
+            _value(fi.map(ADD1).sum().cache()))
+    return {"pid": pid, "ok": True}
+
+
+def payload_resume(pid):
+    """Checkpointed streamed sum over 8 slabs; the parent arms
+    BOLT_CHAOS to SIGKILL every process mid-run, then re-runs this
+    payload clean — the second run must RESUME (stream_resumes >= 1)
+    and reproduce the uninterrupted result bit-identically."""
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import engine
+    out = os.environ["BOLT_MH_OUT"]
+    ck = os.environ["BOLT_MH_CKPT"]
+    n, vdim, chunks = 64, 8, 8                      # 8 slabs of 8
+    x = _crafted(n, vdim)
+    mesh = _mesh()
+    c0 = engine.counters()
+    src = bolt.fromcallback(lambda idx: x[idx], (n, vdim), mesh,
+                            dtype=np.float32, chunks=chunks,
+                            checkpoint=ck, per_process=True)
+    s = src.map(ADD1).sum().cache()
+    c1 = engine.counters()
+    np.save(os.path.join(out, "resume_sum.%d.npy" % pid), _value(s))
+    return {"pid": pid,
+            "resumes": c1["stream_resumes"] - c0["stream_resumes"],
+            "slabs": c1["stream_chunks"] - c0["stream_chunks"]}
+
+
+def payload_bench(pid):
+    """The config-11 / perf-family payload: stream a larger crafted
+    source through the per-process pipeline, recording this process's
+    ingest bytes and wall seconds (per-process GB/s) plus the
+    compile-once counters."""
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import engine, obs
+    from bolt_tpu.obs.trace import clock
+    out = os.environ["BOLT_MH_OUT"]
+    n = int(os.environ.get("BOLT_MH_NKEYS", "4096"))
+    vdim = int(os.environ.get("BOLT_MH_VDIM", "256"))
+    chunks = int(os.environ.get("BOLT_MH_CHUNKS", "512"))
+    x = _crafted(n, vdim)
+    mesh = _mesh()
+    obs.clear()
+    obs.enable()
+
+    def make():
+        return bolt.fromcallback(lambda idx: x[idx], (n, vdim), mesh,
+                                 dtype=np.float32, chunks=chunks,
+                                 per_process=True)
+
+    warm = make().map(ADD1).sum().cache()           # compile + warm
+    _value(warm)
+    c0 = engine.counters()
+    t0 = clock()
+    s = make().map(ADD1).sum().cache()
+    val = _value(s)
+    wall = clock() - t0
+    c1 = engine.counters()
+    np.save(os.path.join(out, "bench_sum.%d.npy" % pid), val)
+    res = {
+        "pid": pid,
+        "wall_s": wall,
+        "transfer_bytes": c1["transfer_bytes"] - c0["transfer_bytes"],
+        "slabs": c1["stream_chunks"] - c0["stream_chunks"],
+        "recompiles_warm": (c1["aot_compiles"] - c0["aot_compiles"]
+                            + c1["misses"] - c0["misses"]),
+        "leaked_spans": obs.active_count(),
+    }
+    obs.disable()
+    return res
+
+
+PAYLOADS = {
+    "stream_parity": payload_stream_parity,
+    "single_ref": payload_single_ref,
+    "resume": payload_resume,
+    "bench": payload_bench,
+}
+
+
+def worker_main(pid):
+    _bootstrap(pid)
+    payload = PAYLOADS[os.environ["BOLT_MH_PAYLOAD"]]
+    res = payload(pid)
+    out = os.environ["BOLT_MH_OUT"]
+    tmp = os.path.join(out, "result.%d.json.tmp" % pid)
+    with open(tmp, "w") as f:
+        json.dump(res, f)
+    os.replace(tmp, os.path.join(out, "result.%d.json" % pid))
+    print("worker %d OK" % pid, flush=True)
+
+
+# ---------------------------------------------------------------------
+# standalone smoke
+# ---------------------------------------------------------------------
+
+def main():
+    import shutil
+    import numpy as np
+    results, out, _ = run_cluster("stream_parity", nproc=2, devs=1)
+    _, out1, _ = run_cluster("single_ref", nproc=1, devs=2, out_dir=out)
+    ok = all(r and r["recompiles_second_pass"] == 0
+             and r["leaked_spans"] == 0 for r in results)
+    a = np.load(os.path.join(out, "sum.0.npy"))
+    b = np.load(os.path.join(out, "sum.1.npy"))
+    ref = np.load(os.path.join(out, "ref_sum.npy"))
+    ok = ok and np.array_equal(a, ref) and np.array_equal(b, ref)
+    for pid in (0, 1):
+        for name in ("stats_sum", "stats_var"):
+            got = np.load(os.path.join(out, "%s.%d.npy" % (name, pid)))
+            want = np.load(os.path.join(out, "ref_%s.npy" % name))
+            ok = ok and np.array_equal(got, want)
+    print("multihost harness smoke:", "PASS" if ok else "FAIL")
+    print(json.dumps(results, indent=1))
+    shutil.rmtree(out, ignore_errors=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        worker_main(int(sys.argv[2]))
+    else:
+        main()

@@ -297,7 +297,8 @@ _EXPECTED_ENGINE_KEYS = {
     "lower_seconds": True, "compile_seconds": True,
     "dispatches": False, "dispatch_seconds": True, "fallbacks": False,
     "donations": False, "persistent_hits": False,
-    "persistent_misses": False, "diagnostics": False,
+    "persistent_misses": False, "persistent_warm_hits": False,
+    "diagnostics": False,
     "strict_checks": False, "strict_rejections": False,
     "transfer_bytes": False, "transfer_seconds": True,
     "stream_chunks": False, "stream_ingest_seconds": True,

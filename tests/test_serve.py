@@ -600,3 +600,111 @@ def test_submit_deadline_validation(mesh):
     with serve.serving(workers=1) as sv:
         with pytest.raises(ValueError, match="positive"):
             sv.submit(lambda: 1, deadline=0)
+
+
+# ---------------------------------------------------------------------
+# weighted fair share (ISSUE 10 satellite)
+# ---------------------------------------------------------------------
+
+def test_weights_validation(mesh):
+    with pytest.raises(ValueError, match="positive integer"):
+        serve.Server(workers=1, weights={"a": 0}).close()
+
+
+def _ordered_pops(weights, jobs):
+    """Submit ``jobs`` (a list of tenant tags) while ONE worker is held
+    on a blocker job, release, and return the order the scheduler ran
+    them in — the weighted-round-robin observable."""
+    order = []
+    gate = threading.Event()
+
+    def blocker():
+        gate.wait(30)
+
+    def tagged(t):
+        return lambda: order.append(t)
+
+    with serve.serving(workers=1, weights=weights) as sv:
+        hold = sv.submit(blocker, tenant="hold")
+        time.sleep(0.15)              # the worker is inside blocker now
+        futs = [sv.submit(tagged(t), tenant=t) for t in jobs]
+        gate.set()
+        hold.result(timeout=60)
+        for f in futs:
+            f.result(timeout=60)
+    return order
+
+
+def test_default_weights_keep_round_robin_order():
+    # a then b queued; weight 1 each -> strict alternation (bit-for-bit
+    # the pre-weights scheduler)
+    order = _ordered_pops(None, ["a"] * 4 + ["b"] * 4)
+    assert order == ["a", "b", "a", "b", "a", "b", "a", "b"]
+
+
+def test_weighted_fair_share_serves_weight_jobs_per_turn():
+    # weight 3 vs 1: each rotation serves up to 3 of a's jobs, then one
+    # of b's — the integer-credit generalisation
+    order = _ordered_pops({"a": 3}, ["a"] * 6 + ["b"] * 2)
+    assert order == ["a", "a", "a", "b", "a", "a", "a", "b"]
+
+
+def test_weighted_fair_share_starvation_freedom():
+    # a floods with a big weight; b (weight 1) is still served within
+    # ONE rotation — at most weight(a) pops after the turn starts
+    order = _ordered_pops({"a": 5}, ["a"] * 12 + ["b"])
+    assert "b" in order
+    assert order.index("b") <= 5, order
+
+
+def test_weight_turn_forfeited_when_queue_drains():
+    # a has weight 3 but only 2 jobs: its turn ends early, b runs next
+    order = _ordered_pops({"a": 3}, ["a", "a", "b", "b"])
+    assert order == ["a", "a", "b", "b"]
+
+
+# ---------------------------------------------------------------------
+# fleet-warm start (ROADMAP item 4 remainder)
+# ---------------------------------------------------------------------
+
+def test_start_warm_serves_first_request_without_fresh_compiles(
+        mesh, tmp_path):
+    """A pre-seeded persistent cache + Server(start_warm=dir): the
+    warmed server's first request re-lowers but runs ZERO fresh XLA
+    compiles (persistent_misses flat), and every disk-served compile is
+    counted as a persistent_warm_hits."""
+    import os
+    cache = str(tmp_path / "warm-xla")
+    x = _x((32, 8, 4))
+
+    def make():
+        return bolt.array(x, mesh).map(ADD1).sum()
+
+    try:
+        # seed: an earlier process ran the fleet's pipeline shape
+        # (clear first — an identical program compiled earlier in THIS
+        # suite would otherwise serve from the in-memory cache and
+        # never reach the disk layer)
+        engine.clear()
+        engine.persistent_cache(cache)
+        np.asarray(make().toarray())
+        if not os.listdir(cache):
+            pytest.skip("backend does not serialize executables")
+        engine.persistent_cache(enable=False)
+
+        # "fresh process": drop the in-memory executables, then serve
+        # with start_warm -- the first request must hit disk only
+        engine.clear()
+        c0 = engine.counters()
+        with serve.serving(workers=1, start_warm=cache) as sv:
+            assert sv.warm_dir == cache
+            out = sv.submit(make(), tenant="w").result(timeout=120)
+        c1 = engine.counters()
+        assert np.allclose(np.asarray(out.toarray()),
+                           (x + 1).sum(axis=0))
+        assert c1["persistent_warm_hits"] > c0["persistent_warm_hits"]
+        assert c1["persistent_misses"] == c0["persistent_misses"], \
+            "warm start paid a fresh XLA compile"
+        assert c1["aot_compiles"] > c0["aot_compiles"]
+    finally:
+        engine.persistent_cache(enable=False)

@@ -612,3 +612,67 @@ def test_lint_cli_check_mode_passes_on_repo():
              "--check", bad], capture_output=True, text=True, timeout=120)
         assert out.returncode == 1
         assert "BLT101" in out.stdout
+
+
+@pytest.mark.lint
+def test_lint_blt110_process_topology_calls():
+    """BLT110: jax.distributed / jax.process_index / jax.process_count
+    are confined to parallel/multihost.py (+ _compat.py) — the one
+    process-topology home."""
+    from bolt_tpu.analysis import astlint
+    src = ("import jax\n\n"
+           "def f():\n    return jax.process_index()\n")
+    assert [x.code for x in astlint.lint_source(
+        src, "bolt_tpu/ops/foo.py")] == ["BLT110"]
+    src2 = ("import jax\n\n"
+            "def f():\n    return jax.process_count() > 1\n")
+    assert [x.code for x in astlint.lint_source(
+        src2, "bolt_tpu/tpu/construct.py")] == ["BLT110"]
+    # the bootstrap chain itself (attribute + call forms)
+    src3 = ("import jax\n\n"
+            "def up():\n    jax.distributed.initialize()\n")
+    assert [x.code for x in astlint.lint_source(
+        src3, "bolt_tpu/checkpoint.py")] == ["BLT110"]
+    # import forms
+    src4 = "import jax.distributed\n"
+    assert [x.code for x in astlint.lint_source(
+        src4, "bolt_tpu/ops/foo.py")] == ["BLT110"]
+    src5 = "from jax import distributed\n"
+    assert [x.code for x in astlint.lint_source(
+        src5, "bolt_tpu/ops/foo.py")] == ["BLT110"]
+    # alias-aware: a renamed jax must not dodge the rule
+    src6 = ("import jax as j\n\n"
+            "def f():\n    return j.process_index()\n")
+    assert [x.code for x in astlint.lint_source(
+        src6, "bolt_tpu/serve.py")] == ["BLT110"]
+    # DEVICE attributes are data, not topology calls: no finding
+    ok = ("def f(mesh):\n"
+          "    return {d.process_index for d in mesh.devices.flat}\n")
+    assert astlint.lint_source(ok, "bolt_tpu/ops/foo.py") == []
+    # the blessed homes pass
+    for home in ("bolt_tpu/parallel/multihost.py", "bolt_tpu/_compat.py"):
+        for s in (src, src2, src3, src4, src5):
+            assert astlint.lint_source(s, home) == []
+    # path anchoring: mymultihost.py does not inherit the pass
+    assert any(x.code == "BLT110" for x in astlint.lint_source(
+        src, "bolt_tpu/parallel/mymultihost.py"))
+    # pragma escape hatch
+    pragma = ("import jax\n"
+              "n = jax.process_count()  "
+              "# lint: allow(BLT110 documented exception)\n")
+    assert astlint.lint_source(pragma, "bolt_tpu/ops/foo.py") == []
+    # the repo itself holds at zero findings with the rule armed
+    assert astlint.lint_package() == []
+
+
+def test_blt012_registered_and_single_process_quiet(mesh):
+    """BLT012 is a registered error-severity code, and a single-process
+    mesh never emits it (the divisibility rule is multi-process only —
+    the 2-process cluster suite proves the firing side)."""
+    from bolt_tpu.analysis.diagnostics import CODES
+    assert CODES["BLT012"][0] == "error"
+    x = np.arange(14 * 3, dtype=np.float32).reshape(14, 3)
+    src = bolt.fromcallback(lambda idx: x[idx], (14, 3), mesh,
+                            dtype=np.float32, chunks=3)  # uneven tail
+    rep = analysis.check(src.map(lambda v: v + 1))
+    assert not rep.has("BLT012")
