@@ -5,8 +5,13 @@ tolerance that does not work.  This module gives the streaming executor,
 the checkpoint layer and the serving scheduler NAMED fault points —
 ``chaos.hit("stream.upload")`` at the top of the uploader hot path,
 ``"stream.dispatch"`` / ``"stream.fold"`` in the consumer,
-``"stream.checkpoint"`` in the checkpoint writer — and a registry that
-trips a chosen one deterministically:
+``"stream.checkpoint"`` in the checkpoint writer, ``"checkpoint.meta"``
+between a pod abort's state write and its meta rename, and the POD
+seams (ISSUE 11): ``"multihost.barrier"``, ``"multihost.collective"``
+(every pod slab dispatch) and ``"podwatch.heartbeat"`` (each liveness
+beat — ``kill`` here is the cleanest deterministic pod-member
+preemption) — and a registry that trips a chosen one
+deterministically:
 
 >>> from bolt_tpu import _chaos as chaos
 >>> chaos.inject("stream.upload", nth=3)          # 3rd upload raises

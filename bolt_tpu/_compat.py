@@ -15,6 +15,21 @@ policy lives in ONE place instead of scattered ``hasattr`` probes:
 * :func:`make_mesh` / :func:`ensure_auto_mesh` — Auto axis-typing where
   the runtime has typed mesh axes; a plain mesh (implicitly Auto — typed
   axes do not exist) otherwise.
+* the **survivable distributed runtime** block
+  (:func:`distributed_initialize` / :func:`distributed_teardown` /
+  :func:`distributed_client` / :func:`clear_backends`) — the pod
+  fault-tolerance layer's foundation (ISSUE 11).  Stock
+  ``jax.distributed.initialize`` builds its coordination-service client
+  with the DEFAULT missed-heartbeat callback, which ``LOG(QFATAL)``'s
+  the process the moment a peer dies ("Terminating process because the
+  JAX distributed service detected fatal errors") — the survivors of a
+  ``kill -9`` are then executed by their own runtime before any
+  recovery code can run.  The survivable bring-up passes a NON-FATAL
+  callback (routed to ``bolt_tpu.parallel.podwatch``) and
+  ``shutdown_on_destruction=False``, so peer death becomes an event the
+  liveness layer handles instead of a process abort.  All of it is
+  version-probed here: runtimes without the ``xla_extension`` hooks
+  fall back to the stock (fatal) ``jax.distributed.initialize``.
 """
 
 import numpy as np
@@ -73,3 +88,184 @@ def axis_size(axis_name):
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(axis_name)
     return int(np.asarray(jax.lax.psum(1, axis_name)))
+
+
+# ---------------------------------------------------------------------
+# the survivable distributed runtime (bolt_tpu.parallel.multihost /
+# bolt_tpu.parallel.podwatch — the pod fault-tolerance foundation)
+# ---------------------------------------------------------------------
+
+def _distributed_state():
+    """jax's distributed-runtime singleton (version-probed)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state
+    except Exception:
+        return None
+
+
+def distributed_client():
+    """The live coordination-service client (the ``jax.distributed``
+    KV store the podwatch heartbeat transport can ride), or ``None``
+    when the distributed runtime is not up."""
+    st = _distributed_state()
+    return getattr(st, "client", None) if st is not None else None
+
+
+def can_survive_peer_loss():
+    """Does this runtime expose the client options the survivable
+    bring-up needs (custom missed-heartbeat callback +
+    shutdown_on_destruction)?"""
+    try:
+        from jax.lib import xla_extension as xe
+        return (hasattr(xe, "get_distributed_runtime_client")
+                and hasattr(xe, "get_distributed_runtime_service"))
+    except Exception:
+        return False
+
+
+# heartbeat tolerance of the SURVIVABLE bring-up: wide enough that the
+# coordination service never declares a peer dead on its own (the
+# liveness layer — bolt_tpu.parallel.podwatch — owns detection, with
+# second-scale deadlines).  One would rather hand the client a benign
+# Python missed_heartbeat_callback, but this jaxlib's nanobind bridge
+# for it is BROKEN (the absl::Status argument has no registered caster:
+# invoking any Python callback aborts the survivor with std::bad_cast —
+# strictly worse than the stock QFATAL), so the fatal path is instead
+# made unreachable by tolerance.
+_SURVIVABLE_HB_INTERVAL = 10          # seconds between runtime heartbeats
+_SURVIVABLE_HB_MISSING = 100000       # ~never: podwatch detects instead
+
+
+def distributed_initialize(coordinator_address, num_processes,
+                           process_id, on_fatal=None, init_timeout=120):
+    """Bring up the distributed runtime with a SURVIVABLE client.
+
+    Like ``jax.distributed.initialize`` — process 0 additionally hosts
+    the coordination service — but peer death can no longer execute the
+    survivors: the stock client's missed-heartbeat/error-poll handler
+    ``LOG(QFATAL)``'s the process the moment the service declares a
+    peer unhealthy, so the service/client heartbeat tolerance is set
+    wide enough that it NEVER fires (detection belongs to
+    ``bolt_tpu.parallel.podwatch``, with second-scale deadlines), and
+    ``shutdown_on_destruction=False`` keeps a survivor's client
+    teardown off the doomed shutdown barrier.  ``on_fatal`` is
+    accepted for API symmetry but NOT installed — this jaxlib's
+    Python-callback bridge aborts on invocation (see the comment
+    above).  Falls back to the stock fatal initialize on runtimes
+    without the hooks.  Returns True when the survivable path was
+    taken."""
+    del on_fatal                      # see the bridge note above
+    st = _distributed_state()
+    if st is None or not can_survive_peer_loss():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        return False
+    from jax.lib import xla_extension as xe
+    if process_id == 0 and getattr(st, "service", None) is None:
+        st.service = xe.get_distributed_runtime_service(
+            "[::]:" + str(coordinator_address).rsplit(":", 1)[1],
+            num_processes,
+            heartbeat_interval=_SURVIVABLE_HB_INTERVAL,
+            max_missing_heartbeats=_SURVIVABLE_HB_MISSING)
+    if getattr(st, "client", None) is not None:
+        raise RuntimeError("distributed client already initialized")
+    client = xe.get_distributed_runtime_client(
+        coordinator_address, process_id, init_timeout=init_timeout,
+        heartbeat_interval=_SURVIVABLE_HB_INTERVAL,
+        max_missing_heartbeats=_SURVIVABLE_HB_MISSING,
+        shutdown_on_destruction=False, use_compression=True)
+    client.connect()
+    st.client = client
+    st.process_id = process_id
+    st.num_processes = num_processes
+    st.coordinator_address = coordinator_address
+    return True
+
+
+def distributed_teardown(graceful=True):
+    """Release the distributed runtime's client/service WITHOUT the
+    stock shutdown's fatal error paths: a clean pod may take the
+    shutdown barrier (``graceful=True``); a pod that lost a peer must
+    NOT (the barrier would fail against the dead task and the stock
+    path aborts the process) — its handles are dropped instead.
+
+    ORDER MATTERS on the non-graceful path: the coordination client's
+    error-poll thread ``LOG(QFATAL)``'s the process if the service
+    vanishes under it, and the gloo-backed CPU backend holds a
+    reference to the client — so the backends must be released FIRST
+    (``clear_backends``, which the reform path runs before this), the
+    client reference dropped (its destructor cancels and joins the
+    poll thread), and only then may a coordinator shut its service
+    down.  Survivors on OTHER processes poll this service too: it is
+    shut down on a delay-free best-effort basis only at graceful exit;
+    a reforming coordinator leaves it running (tolerant heartbeats
+    keep it silent) so a peer mid-reform never observes the
+    "coordination service unavailable" fatal."""
+    st = _distributed_state()
+    if st is None:
+        return
+    client = getattr(st, "client", None)
+    if client is not None:
+        if graceful:
+            try:
+                client.shutdown()
+            except Exception:
+                pass
+        st.client = None
+        del client                    # destructor joins the poll thread
+    if getattr(st, "service", None) is not None:
+        if graceful:
+            try:
+                st.service.shutdown()
+            except Exception:
+                pass
+        else:
+            # leave the old service RUNNING: peers' old clients may
+            # still be polling it mid-reform, and killing it converts
+            # their tolerant silence into the fatal UNAVAILABLE poll.
+            # It idles on the old port for the rest of the process
+            # (reforms are rare; the new service binds a fresh port).
+            _ORPHANED_SERVICES.append(st.service)
+        st.service = None
+    st.process_id = 0
+    st.num_processes = None
+    st.coordinator_address = None
+
+
+# services a non-graceful teardown abandons (kept referenced so their
+# destructors never run mid-flight; see distributed_teardown)
+_ORPHANED_SERVICES = []
+
+
+def clear_backends():
+    """Forget every live XLA backend (and the jit caches pinning them)
+    so the next backend query rebuilds against the CURRENT distributed
+    topology — the reform step between ``distributed_teardown`` and a
+    re-``distributed_initialize`` on a shrunk pod.  The topology query
+    helpers (``process_count``/``process_index``/device counts) are
+    ``lru_cache``'d ON TOP of the backend table and must be dropped
+    with it, or a reformed pod keeps answering with the dead
+    topology."""
+    from jax._src import xla_bridge as xb
+    if not hasattr(xb, "_clear_backends"):
+        # refusing beats pretending: a reform that cannot drop the old
+        # backends would hand the caller a "recovered" runtime whose
+        # gloo contexts still point at the dead topology
+        raise RuntimeError(
+            "this jax version exposes no backend-reset hook "
+            "(jax._src.xla_bridge._clear_backends); multihost.reform "
+            "cannot rebuild the runtime in-process here — restart the "
+            "surviving processes over the same checkpoint dir instead")
+    xb._clear_backends()
+    for name in ("process_count", "process_index", "device_count",
+                 "local_device_count", "process_indices"):
+        fn = getattr(xb, name, None)
+        if fn is not None and hasattr(fn, "cache_clear"):
+            fn.cache_clear()
+        jfn = getattr(jax, name, None)
+        if jfn is not None and jfn is not fn \
+                and hasattr(jfn, "cache_clear"):
+            jfn.cache_clear()
+    jax.clear_caches()

@@ -40,13 +40,14 @@ Rules (the ``BLT1xx`` range; the abstract pipeline checker owns
   window, the counted transfer layer, and the profiling barriers, not
   to op code.
 * **BLT108** — no raw ``threading.Thread`` / pool-executor construction
-  outside ``stream.py`` and ``serve.py``.  Concurrency has exactly two
-  blessed homes: the streaming executor's uploader pool and the
-  serving layer's scheduler — both arbiter-aware, fault-funnelled and
-  obs-instrumented.  A stray thread elsewhere bypasses the
-  device-memory budget, the tenant counter scoping and the liveness
-  guards (locks, events, and conditions are fine; it is thread
-  *construction* that must be centralised).
+  outside ``stream.py``, ``serve.py`` and ``parallel/podwatch.py``.
+  Concurrency has exactly three blessed homes: the streaming
+  executor's uploader pool, the serving layer's scheduler, and the pod
+  liveness watch's heartbeat thread — all arbiter-aware or
+  fault-funnelled and obs-instrumented.  A stray thread elsewhere
+  bypasses the device-memory budget, the tenant counter scoping and
+  the liveness guards (locks, events, and conditions are fine; it is
+  thread *construction* that must be centralised).
 * **BLT109** — no ``os.kill``/``signal`` use outside ``_chaos.py``,
   tests and scripts.  Fault injection has ONE blessed home — the
   deterministic chaos registry (``bolt_tpu/_chaos.py``) and its named
@@ -105,9 +106,10 @@ _EXEMPT = {
     # the executor's window/transfer syncs, the engine's AOT plumbing,
     # and profile's timing barriers are the sanctioned sync points
     "BLT107": ("stream.py", "engine.py", "profile.py"),
-    # the two blessed concurrency homes: the uploader pool + the
-    # multi-tenant scheduler
-    "BLT108": ("stream.py", "serve.py"),
+    # the three blessed concurrency homes: the uploader pool, the
+    # multi-tenant scheduler, and the pod liveness heartbeat
+    "BLT108": ("stream.py", "serve.py",
+               os.path.join("parallel", "podwatch.py")),
     # the one blessed fault-injection home (plus tests/scripts, whose
     # whole job is to trip and observe faults)
     "BLT109": ("_chaos.py", "tests" + os.sep, "scripts" + os.sep),

@@ -602,6 +602,75 @@ def _note_resumable(src, idx, diags):
              "retired slabs"))
 
 
+def _stream_ckpt_dir(src):
+    """The checkpoint dir a run over ``src`` would use (per-source
+    ``checkpoint=`` wins over the thread's ``resumable()`` scope), or
+    ``None``."""
+    from bolt_tpu import stream as _stream
+    if src.ckpt is not None:
+        return src.ckpt
+    scope = _stream.checkpoint_scope()
+    return scope[0] if scope is not None else None
+
+
+def _recovery_plan(src, nproc):
+    """The pod fault-tolerance plan ``explain()`` renders for a
+    multi-process stream: heartbeat cadence, watchdog deadline, and the
+    resume topology a peer loss would lead to (ISSUE 11)."""
+    from bolt_tpu.parallel import podwatch as _pw
+    cfg = _pw.config()
+    if cfg.get("timeout"):
+        hb = ("peer loss -> PeerLostError (heartbeat %.3gs, watchdog "
+              "deadline %.3gs, %s transport)"
+              % (cfg["interval"], cfg["timeout"], cfg["transport"]))
+    else:
+        hb = "watchdog OFF (BOLT_POD_TIMEOUT=0): peer loss may hang"
+    ck_dir = _stream_ckpt_dir(src)
+    if ck_dir is not None:
+        resume = ("resume topology: reform to the survivors (<= %d "
+                  "processes) and resume from %r" % (nproc - 1, ck_dir))
+    else:
+        resume = ("NO checkpoint dir: peer loss discards all partials "
+                  "(BLT013)")
+    return "recovery plan: %s; %s" % (hb, resume)
+
+
+def _note_pod_recovery(src, nproc, idx, diags):
+    """``BLT013``: this pipeline streams across processes but has no
+    recovery path — either no checkpoint dir is armed (a single peer
+    loss discards every fold partial) or the mesh is SUB-POD (the
+    checkpoint rendezvous covers the whole runtime, so resumable
+    checkpointing is refused there)."""
+    if nproc <= 1:
+        return
+    from bolt_tpu.parallel import multihost as _mh
+    ck_dir = _stream_ckpt_dir(src)
+    if ck_dir is None:
+        diags.append(Diagnostic(
+            "BLT013", idx,
+            "this pipeline streams across %d processes with NO "
+            "checkpoint dir: a single peer loss discards every fold "
+            "partial and the whole run restarts from scratch "
+            "(recovery impossible)" % nproc,
+            hint="arm stream.resumable(dir) or fromcallback/fromiter "
+                 "checkpoint=dir so the survivors can "
+                 "multihost.reform() and resume from the last "
+                 "rendezvous-consistent watermark"))
+        return
+    runtime = _mh.process_count()
+    if runtime > 1 and nproc != runtime:
+        diags.append(Diagnostic(
+            "BLT013", idx,
+            "this stream's mesh spans %d of the runtime's %d "
+            "processes (a SUB-POD mesh): the checkpoint rendezvous "
+            "barrier covers the whole runtime, so resumable "
+            "checkpointing is refused and peer loss discards all "
+            "partials" % (nproc, runtime),
+            hint="stream the checkpointed run on a mesh covering "
+                 "every process, or drop checkpoint=/resumable() for "
+                 "this sub-mesh run"))
+
+
 def _check_stream(arr, target, stages, diags):
     """Abstractly interpret a STREAMING plan (a lazy ``fromcallback``/
     ``fromiter`` source plus its recorded device-side stages).  Nothing
@@ -629,6 +698,10 @@ def _check_stream(arr, target, stages, diags):
                  % (nproc, src.slab // nproc,
                     _mh.key_collective_axes(mesh, src.shape,
                                             walk_split) or ("?",)))
+        # the RECOVERY PLAN (ISSUE 11): what happens to this run when a
+        # peer dies — heartbeat cadence, watchdog deadline, and the
+        # topology a reform would resume on
+        note += "; " + _recovery_plan(src, nproc)
     stages.append(Stage(
         0, "stream source (%s)" % src.kind, aval.shape,
         np.dtype(aval.dtype), walk_split,
@@ -650,6 +723,7 @@ def _check_stream(arr, target, stages, diags):
                      "cannot stream across processes"))
     _note_admission(_stream_slab_bytes(src), 0, diags)
     _note_resumable(src, 0, diags)
+    _note_pod_recovery(src, nproc, 0, diags)
     idle_seen = _idle_device_check(mesh, aval.shape, walk_split, 0, diags,
                                    False)
     dynamic = False

@@ -35,6 +35,9 @@ CODES = {
     "BLT012": ("error",
                "streamed key axis does not divide the multi-process "
                "topology"),
+    "BLT013": ("warning",
+               "multi-process stream has no recovery path: peer loss "
+               "discards all partials"),
 }
 
 SEVERITIES = ("error", "warning", "info")

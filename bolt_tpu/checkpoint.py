@@ -174,6 +174,18 @@ def load(path, context=None):
 # file for EVERY process — the peers can never resume from different
 # watermarks (which would cross the collective fold).  The directory
 # must be shared storage (every pod checkpoint system's contract).
+#
+# Two pod REFINEMENTS ride on one fact (ISSUE 11): the executor's fold
+# partials are psum-REPLICATED global values, so every shard file at
+# one watermark holds the SAME complete accumulator.  (1) The ABORT
+# path (stream_save(rendezvous=False)) lets a survivor persist its
+# watermark with no barrier — peers may be dead — under an
+# advance-only meta flip; a retired watermark implies every process
+# participated in those slabs' collectives, so the point is
+# rendezvous-consistent by construction.  (2) The TOPOLOGY REMAP
+# (stream_load on a different process count) lets a pod that SHRANK
+# (multihost.reform after a peer loss) adopt any surviving shard file
+# and resume bit-identically on M<N processes.
 
 _STATE_NAME = "stream_state.npz"
 _SMETA_NAME = "stream_meta.json"
@@ -221,7 +233,7 @@ def _decode(node, leaves):
 
 
 def stream_save(path, fingerprint, slabs, records, state,
-                multiprocess=None):
+                multiprocess=None, rendezvous=True, remap_from=None):
     """Persist one streamed-run checkpoint: ``slabs`` retired slabs
     covering ``records`` records, with ``state`` the executor's folded
     partial accumulator (``(levels, pend)`` — device values are pulled
@@ -239,7 +251,24 @@ def stream_save(path, fingerprint, slabs, records, state,
     executor passes its MESH's answer, because a process-local mesh
     inside a multi-process runtime streams (and must checkpoint)
     single-process: its peers are not at this watermark, and a barrier
-    here would hang them.  ``None`` falls back to the runtime query."""
+    here would hang them.  ``None`` falls back to the runtime query.
+
+    ``rendezvous=False`` is the POD ABORT path (ISSUE 11): a survivor
+    whose run just failed (peer death, injected fault) persists its
+    watermark WITHOUT any barrier — peers may be dead or at other
+    watermarks.  Safe because a pod run's fold partials are
+    psum-replicated GLOBAL values: a retired watermark implies every
+    process participated in those slabs' collectives, so ONE process's
+    abort state is a complete, rendezvous-consistent resume point.
+    The meta advances ONLY forward (an existing same-fingerprint meta
+    at a higher-or-equal watermark is left alone), state-first /
+    meta-last as always — a torn abort can never flip meta at a
+    watermark whose state did not land.
+
+    ``remap_from`` records a topology remap in the meta (the resumed
+    run's first checkpoint after a shrink names the pod width the
+    loaded checkpoint was cut by) — the audit trail that makes a
+    3→2-process resume explainable from the directory alone."""
     _chaos.hit("stream.checkpoint")
     os.makedirs(path, exist_ok=True)
     if multiprocess is None:
@@ -264,22 +293,39 @@ def stream_save(path, fingerprint, slabs, records, state,
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
     os.replace(tmp, spath)
-    if nproc > 1:
+    if nproc > 1 and rendezvous:
         # every peer's shard file for THIS watermark exists past here —
         # only then may the meta name it
         _multihost.barrier("bolt_stream_ckpt_w%d" % int(slabs))
     meta = {"fingerprint": list(fingerprint), "slabs": int(slabs),
             "records": int(records), "structure": structure,
             "leaves": len(leaves), "nproc": nproc}
+    if remap_from is not None:
+        meta["remapped_from"] = int(remap_from)
+    if nproc > 1 and not rendezvous:
+        meta["abort"] = True
+        # advance-only: survivors may abort at different watermarks and
+        # each flips the meta for itself — a LOWER watermark must never
+        # overwrite a higher one (both are valid resume points; keep
+        # the one that loses the least work).  The read-then-rename
+        # window is benign: every candidate meta names a complete,
+        # rendezvous-consistent state (see the docstring).
+        cur = _read_meta(path)
+        if cur is not None and \
+                list(cur.get("fingerprint", ())) == list(fingerprint) \
+                and int(cur.get("slabs", -1)) >= int(slabs):
+            return sum(int(leaf.nbytes) for leaf in leaves)
     # single-process checkpoints are written by WHOEVER streams them —
     # a process-local mesh may live on a non-zero runtime process; only
-    # the pod format elects process 0 as the one meta writer
-    if nproc == 1 or pid == 0:
+    # the pod format elects process 0 as the one meta writer (abort
+    # writes have no rendezvous, so every survivor writes for itself)
+    if nproc == 1 or pid == 0 or not rendezvous:
+        _chaos.hit("checkpoint.meta")
         tmp = _smeta_path(path) + ".tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, _smeta_path(path))
-    if nproc > 1:
+    if nproc > 1 and rendezvous:
         # fence the cleanup: superseded shard files may vanish only
         # once the meta durably points at the new watermark everywhere
         _multihost.barrier("bolt_stream_ckpt_meta_w%d" % int(slabs))
@@ -293,7 +339,15 @@ def stream_save(path, fingerprint, slabs, records, state,
     return sum(int(leaf.nbytes) for leaf in leaves)
 
 
-def stream_load(path, fingerprint, multiprocess=None):
+def _read_meta(path):
+    try:
+        with open(_smeta_path(path)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def stream_load(path, fingerprint, multiprocess=None, info=None):
     """Load a streamed-run checkpoint written by :func:`stream_save`:
     ``(slabs, records, state)`` with host-array leaves, or ``None``
     when no checkpoint exists, its fingerprint names a DIFFERENT
@@ -304,23 +358,42 @@ def stream_load(path, fingerprint, multiprocess=None):
 
     A multi-process run loads the SHARED meta (so every peer agrees on
     the watermark) and this process's own shard file for that
-    watermark; a checkpoint cut by a different process count is
-    refused — a resumed pod must match the topology that wrote it.
-    ``multiprocess`` mirrors :func:`stream_save`'s (the executor passes
-    its mesh's answer; ``None`` = the runtime query)."""
-    if not os.path.exists(_smeta_path(path)):
+    watermark.  A checkpoint cut by a DIFFERENT process count performs
+    a **topology remap** (ISSUE 11 shrink-and-resume): a pod run's
+    fold partials are psum-replicated global values — every shard file
+    at one watermark holds the same complete accumulator — so a
+    resumed M<N-process pod (or a single process) adopts any surviving
+    shard file of the meta's watermark (own index preferred, lowest
+    index otherwise).  ``info``, when a dict, receives
+    ``{"remapped_from": N}`` so the executor can record the remap in
+    its next checkpoint write.  ``multiprocess`` mirrors
+    :func:`stream_save`'s (the executor passes its mesh's answer;
+    ``None`` = the runtime query)."""
+    meta = _read_meta(path)           # None on missing OR malformed:
+    if meta is None:                  # a torn meta is not a checkpoint
         return None
-    with open(_smeta_path(path)) as f:
-        meta = json.load(f)
     if list(meta.get("fingerprint", ())) != list(fingerprint):
         return None
     if multiprocess is None:
         multiprocess = _multihost.process_count() > 1
     nproc = _multihost.process_count() if multiprocess else 1
-    if int(meta.get("nproc", 1)) != nproc:
-        return None                 # cut by a different pod topology
-    spath = _state_path(path) if nproc == 1 else _state_path(
-        path, _multihost.process_index(), int(meta["slabs"]))
+    meta_nproc = int(meta.get("nproc", 1))
+    if meta_nproc == nproc:
+        spath = _state_path(path) if nproc == 1 else _state_path(
+            path, _multihost.process_index(), int(meta["slabs"]))
+        if nproc > 1 and not os.path.exists(spath):
+            # this index's file never landed (it was the dead peer's
+            # name, or an abort write) — any peer's file is the same
+            # replicated global state
+            spath = _remap_state_path(path, meta)
+    else:
+        # topology remap: the checkpoint was cut by a different pod
+        # width — adopt a surviving shard file (replicated state)
+        spath = _remap_state_path(path, meta)
+        if spath is not None and info is not None:
+            info["remapped_from"] = meta_nproc
+    if spath is None:
+        return None
     try:
         with np.load(spath) as z:
             wm = z["watermark"]
@@ -333,6 +406,32 @@ def stream_load(path, fingerprint, multiprocess=None):
         return None                 # meta/state from different writes
     state = _decode(meta["structure"], leaves)
     return int(meta["slabs"]), int(meta["records"]), state
+
+
+def _remap_state_path(path, meta):
+    """A usable state file for ``meta``'s watermark, whatever topology
+    cut it: this process's own shard file when present, else the
+    lowest-index survivor's, else the single-process file.  Valid
+    because pod fold partials are replicated global values (see
+    :func:`stream_load`)."""
+    if int(meta.get("nproc", 1)) == 1:
+        sp = _state_path(path)
+        return sp if os.path.exists(sp) else None
+    slabs = int(meta["slabs"])
+    own = _state_path(path, _multihost.process_index(), slabs)
+    if os.path.exists(own):
+        return own
+    cands = glob.glob(os.path.join(path, "stream_state.p*.w%d.npz"
+                                   % slabs))
+    if not cands:
+        return None
+
+    def _pid_of(p):
+        try:
+            return int(os.path.basename(p).split(".p")[1].split(".w")[0])
+        except (IndexError, ValueError):
+            return 1 << 30
+    return min(cands, key=_pid_of)
 
 
 def stream_clear(path, multiprocess=None):
@@ -355,15 +454,23 @@ def stream_clear(path, multiprocess=None):
             except FileNotFoundError:
                 pass
         _multihost.barrier("bolt_stream_clear_meta")
-        for p in glob.glob(os.path.join(
-                path, "stream_state.p%d.w*.npz"
-                % _multihost.process_index())):
+        # every peer removes its own shard files; process 0 sweeps the
+        # REST too — a pod that shrank (reform) leaves dead peers'
+        # stale shard files behind that no surviving index would claim
+        pat = ("stream_state.p*.w*.npz"
+               if _multihost.process_index() == 0
+               else "stream_state.p%d.w*.npz"
+               % _multihost.process_index())
+        for p in glob.glob(os.path.join(path, pat)):
             try:
                 os.remove(p)
             except FileNotFoundError:
                 pass
         return
-    for p in (_smeta_path(path), _state_path(path)):
+    for p in [_smeta_path(path), _state_path(path)] + glob.glob(
+            os.path.join(path, "stream_state.p*.w*.npz")):
+        # the glob: a single process that resumed a POD checkpoint via
+        # the topology remap must not leave the pod's shard files stale
         try:
             os.remove(p)
         except FileNotFoundError:

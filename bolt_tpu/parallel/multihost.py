@@ -29,18 +29,44 @@ the devices.  This module is the ONE place that knows about that
   per-process split does not exist (the analysis checker emits the
   same message as a ``BLT012`` diagnostic; the executor refuses with
   it before any thread starts).
-* :func:`barrier` — a named cross-process rendezvous
-  (``multihost_utils.sync_global_devices``) taken under the engine's
+* :func:`barrier` — a named cross-process rendezvous.  With the
+  liveness watch running (``bolt_tpu.parallel.podwatch``) it is the
+  WATCHDOG barrier: a transport-level rendezvous that converts a dead
+  peer into a pointed :class:`podwatch.PeerLostError` instead of
+  blocking in a dead collective; otherwise it is
+  ``multihost_utils.sync_global_devices`` taken under the engine's
   dispatch-order lock, so a barrier collective can never interleave
   with another thread's program enqueue inside one process.
 * :func:`local_value` — the host view of a replicated global array
   (``np.asarray`` refuses non-fully-addressable arrays; every process
   holds a full copy of a ``P()``-replicated value in its own shards).
+* :func:`reform` — the SHRINK-AND-RESUME door (ISSUE 11): after a peer
+  death, the survivors tear the runtime down (without the stock
+  shutdown's fatal barrier), rebuild it as an M<N-process cluster on a
+  fresh coordinator, and notify ``podwatch.on_reform`` subscribers —
+  a checkpointed stream then resumes on the smaller pod from the last
+  rendezvous-consistent watermark.
+
+The bring-up is SURVIVABLE (``_compat.distributed_initialize``): the
+stock client ``LOG(QFATAL)``'s every survivor the moment one peer dies
+— the exact outage this layer exists to handle — so the coordination
+service's own failure detection is made unreachable (wide heartbeat
+tolerance + ``shutdown_on_destruction=False``; this jaxlib's Python
+error-callback bridge aborts on invocation, so no callback can be
+installed).  Peer-death DETECTION therefore belongs entirely to
+``podwatch``: its own heartbeats, the transport-failure latch, and
+the gloo transport-error signatures.
 """
 
 import numpy as np
 
 import jax
+
+from bolt_tpu import _chaos
+from bolt_tpu import _compat
+from bolt_tpu.parallel import podwatch
+from bolt_tpu.parallel.podwatch import PeerLostError  # noqa: F401 — the
+#   blessed re-export: callers catch multihost.PeerLostError
 
 # ---------------------------------------------------------------------
 # bootstrap / teardown
@@ -61,9 +87,14 @@ def initialize(coordinator_address=None, num_processes=None,
     Call BEFORE any backend query (device listing, array construction).
     On CPU the gloo collective transport is configured first — the
     2-process localhost test clusters run real cross-process programs
-    through it.  Idempotent: returns ``True`` when this call initialised
-    the runtime, ``False`` when it was already up (or the runtime
-    declined — a plain single-process run)."""
+    through it.  The client is brought up SURVIVABLE where the runtime
+    allows (`_compat.distributed_initialize`): a dead peer becomes a
+    ``podwatch`` event, not a process abort — and the per-process
+    liveness watch starts automatically on every multi-process
+    bring-up (disable with ``BOLT_POD_TIMEOUT=0``).  Idempotent:
+    returns ``True`` when this call initialised the runtime, ``False``
+    when it was already up (or the runtime declined — a plain
+    single-process run)."""
     global _INITIALIZED
     if _INITIALIZED:
         return False
@@ -75,24 +106,44 @@ def initialize(coordinator_address=None, num_processes=None,
     except Exception:
         pass
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+        if None in (coordinator_address, num_processes, process_id):
+            # auto-detection (or the plain single-process decline) is
+            # the stock path's job
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        else:
+            _compat.distributed_initialize(
+                coordinator_address, int(num_processes), int(process_id),
+                on_fatal=podwatch.coordination_error)
     except (RuntimeError, ValueError):
         # already initialised elsewhere, or a single-process run
         return False
     _INITIALIZED = True
+    if int(num_processes or jax.process_count()) > 1:
+        podwatch.start(int(num_processes or jax.process_count()),
+                       int(process_id if process_id is not None
+                           else jax.process_index()))
     return True
 
 
 def shutdown():
     """Tear down a runtime :func:`initialize` brought up (no-op
-    otherwise — a runtime initialised elsewhere is not ours to stop)."""
+    otherwise — a runtime initialised elsewhere is not ours to stop).
+    The teardown is graceful (shutdown barrier) only while every peer
+    is alive; next to a dead peer the handles are simply dropped — the
+    stock barrier would abort the process."""
     global _INITIALIZED
     if not _INITIALIZED:
         return False
+    graceful = not podwatch.dead_peers()
+    # farewell: a deliberately-exiting process goes heartbeat-silent
+    # while it waits in the coordination shutdown barrier — without the
+    # marker a peer still streaming past BOLT_POD_TIMEOUT would latch
+    # this clean leaver DEAD and poison its own healthy run
+    podwatch.stop(farewell=True)
     try:
-        jax.distributed.shutdown()
+        _compat.distributed_teardown(graceful=graceful)
     except (RuntimeError, ValueError):
         pass
     _INITIALIZED = False
@@ -102,6 +153,65 @@ def shutdown():
 def is_initialized():
     """Did :func:`initialize` bring up the distributed runtime?"""
     return _INITIALIZED
+
+
+def reform(coordinator_address, num_processes, process_id=None):
+    """Shrink-and-resume (ISSUE 11): rebuild the distributed runtime on
+    the SURVIVORS of a peer death as a ``num_processes``-wide cluster.
+
+    ::
+
+        try:
+            big.sum().cache()              # 3-process pod, peer dies
+        except multihost.PeerLostError:
+            multihost.reform("10.0.0.1:8477", num_processes=2)
+            ...rebuild mesh from jax.devices(), re-run the pipeline...
+
+    Every survivor calls this with the SAME fresh coordinator address;
+    ``process_id`` defaults to this process's rank among the surviving
+    old indices (the liveness watch's view — survivors all compute the
+    same mapping).  The old client/service are dropped WITHOUT the
+    shutdown barrier (it would fail against the dead task), every XLA
+    backend and jit cache is cleared (``_compat.clear_backends`` — the
+    new backend must see the new topology), the engine's executable
+    cache is dropped (old entries pin programs compiled against dead
+    backends), and the liveness watch restarts for the new epoch.
+    ``podwatch.on_reform`` subscribers (the serving layer's admission
+    drain) are notified last.  Returns the new process id."""
+    global _INITIALIZED
+    if process_id is None:
+        alive = podwatch.alive_peers()
+        if not alive:
+            raise RuntimeError(
+                "multihost.reform needs process_id= when no liveness "
+                "watch is running (the survivors' rank mapping comes "
+                "from podwatch.alive_peers)")
+        old_pid = process_index()
+        if old_pid not in alive:
+            raise RuntimeError(
+                "multihost.reform: this process (%d) is not among the "
+                "surviving peers %s" % (old_pid, list(alive)))
+        process_id = alive.index(old_pid)
+    if int(num_processes) < 1:
+        raise ValueError("reform num_processes must be >= 1, got %r"
+                         % (num_processes,))
+    podwatch.stop(farewell=True)
+    # backends first: the gloo-backed CPU client references the
+    # coordination client, and that reference must drop BEFORE the
+    # client handle goes (its destructor joins the error-poll thread —
+    # see _compat.distributed_teardown's ordering contract)
+    _compat.clear_backends()
+    _compat.distributed_teardown(graceful=False)
+    from bolt_tpu import engine as _engine
+    _engine.clear()
+    _compat.distributed_initialize(
+        coordinator_address, int(num_processes), int(process_id),
+        on_fatal=podwatch.coordination_error)
+    _INITIALIZED = True
+    if int(num_processes) > 1:
+        podwatch.start(int(num_processes), int(process_id))
+    podwatch.notify_reform()
+    return int(process_id)
 
 
 # ---------------------------------------------------------------------
@@ -158,11 +268,20 @@ def local_value(x):
 def barrier(name):
     """Named cross-process rendezvous (no-op single-process).
 
-    Taken under the engine's dispatch-order lock: the barrier is a
-    collective program, and a second thread enqueueing another program
-    mid-barrier would interleave the per-device queues — the exact
-    deadlock the order lock exists to prevent."""
+    With the liveness watch running this is the WATCHDOG barrier
+    (``podwatch.barrier``): a transport-level rendezvous that raises a
+    pointed :class:`PeerLostError` on every survivor when a peer dies
+    before arriving — within ~one heartbeat timeout, never an infinite
+    hang.  Without a watch it falls back to the device-collective
+    rendezvous, taken under the engine's dispatch-order lock: the
+    barrier is a collective program, and a second thread enqueueing
+    another program mid-barrier would interleave the per-device queues
+    — the exact deadlock the order lock exists to prevent."""
     if process_count() <= 1:
+        return
+    _chaos.hit("multihost.barrier")
+    if podwatch.active():
+        podwatch.barrier(name)
         return
     from jax.experimental import multihost_utils
     from bolt_tpu import engine as _engine

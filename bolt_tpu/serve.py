@@ -81,6 +81,10 @@ from bolt_tpu import engine as _engine
 from bolt_tpu.obs import metrics as _metrics
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.obs.trace import clock as _clock
+from bolt_tpu.parallel import podwatch as _podwatch
+from bolt_tpu.parallel.podwatch import PeerLostError  # noqa: F401 — the
+#   retryable pod-outage error submit(retries=) honours; re-exported so
+#   serving callers need not import the liveness layer
 
 # ---------------------------------------------------------------------
 # configuration
@@ -105,6 +109,8 @@ _SCHEMA = {
     "run_seconds": 0.0,        # total start->finish execution time
     "retried": 0,              # per-submit retry attempts consumed
     "expired": 0,              # jobs failed on their deadline= budget
+    "peer_losses": 0,          # pod peer deaths observed (ISSUE 11 —
+                               # admission drained until the reform)
 }
 
 
@@ -469,6 +475,18 @@ class Server:
         self._cancel = threading.Event()   # close(wait=False) ONLY: a
         #                                    leased job's arbiter wait
         #                                    must survive a clean drain
+        # pod fault integration (ISSUE 11): a peer death drains
+        # admission — in-flight streamed futures fail with the
+        # executor's PeerLostError (their arbiter leases return in the
+        # worker's finally), workers start nothing new — until
+        # multihost.reform notifies the liveness layer and the queue
+        # resumes.  Subscriptions are deregistered on close().
+        self._pod_ok = threading.Event()
+        self._pod_ok.set()
+        self._pod_lost = None
+        self._pw_handles = (
+            _podwatch.on_peer_death(self._on_peer_death),
+            _podwatch.on_reform(self._on_pod_reform))
         reg = _metrics.registry()
         self._counters = reg.group("serve", _SCHEMA)
         self._g_depth = reg.gauge("serve.queue_depth")
@@ -480,6 +498,32 @@ class Server:
             for i in range(self.workers)]
         for th in self._threads:
             th.start()
+
+    # -- pod fault integration (bolt_tpu.parallel.podwatch) ------------
+
+    def _on_peer_death(self, pid):
+        """Liveness-watch callback: a pod peer died — drain admission
+        until the pod reforms.  Fired from the watch thread."""
+        self._pod_lost = pid
+        self._pod_ok.clear()
+        self._counters.add("peer_losses")
+        _obs.event("serve.peer_lost", peer=pid)
+        with self._cond:
+            self._cond.notify_all()
+
+    def _on_pod_reform(self):
+        """Liveness-watch callback: ``multihost.reform`` rebuilt the
+        runtime on the survivors — resume the queue."""
+        self._pod_lost = None
+        self._pod_ok.set()
+        _obs.event("serve.pod_resumed")
+        with self._cond:
+            self._cond.notify_all()
+
+    def pod_paused(self):
+        """Is admission drained behind a pod peer loss (awaiting
+        ``multihost.reform``)?"""
+        return not self._pod_ok.is_set()
 
     # -- submission ----------------------------------------------------
 
@@ -511,6 +555,19 @@ class Server:
         if self._closing:
             raise RuntimeError("serve.Server is closed")
         tenant = str(tenant)
+        if not self._pod_ok.is_set():
+            # admission is drained behind a pod peer loss: reject-policy
+            # servers refuse pointedly, queue-policy servers apply
+            # backpressure until multihost.reform resumes the pod
+            if self.policy == "reject":
+                self._reject(tenant,
+                             "admission drained: pod peer %s was lost "
+                             "and the pod has not reformed yet "
+                             "(multihost.reform resumes the queue)"
+                             % self._pod_lost)
+            while not self._pod_ok.wait(0.05):
+                if self._closing:
+                    raise RuntimeError("serve.Server is closed")
         retries = max(0, int(retries))
         if deadline is not None:
             deadline = float(deadline)
@@ -584,6 +641,14 @@ class Server:
         drains mid-turn forfeits the rest of its credits."""
         with self._cond:
             while True:
+                if not self._pod_ok.is_set() and not self._stop.is_set():
+                    # peer lost: drain — current jobs finish (or fail
+                    # with PeerLostError), nothing new starts until the
+                    # reform notification (close() still drains: a
+                    # stopping server must terminate, and its jobs fail
+                    # fast against the dead pod)
+                    self._cond.wait(0.05)
+                    continue
                 for _ in range(len(self._ring)):
                     t = self._ring[0]
                     q = self._queues.get(t)
@@ -628,6 +693,20 @@ class Server:
                     _clock() - fut.submitted_s > deadline
                 allowed = attempt < nretry and not expired \
                     and not self._cancel.is_set()
+                if allowed and isinstance(exc, PeerLostError):
+                    # a pod outage IS retryable (the whole point of
+                    # retries= under serving) — but only once the pod
+                    # reforms: hold the re-attempt behind the admission
+                    # drain instead of burning the budget into a dead
+                    # pod.  Deadline, cancel AND a closing server cut
+                    # it off — close(wait=True) must terminate even
+                    # when the reform never comes.
+                    while allowed and not self._pod_ok.wait(0.05):
+                        if self._cancel.is_set() or self._stop.is_set() \
+                                or (deadline is not None
+                                    and _clock() - fut.submitted_s
+                                    > deadline):
+                            allowed = False
                 if allowed:
                     self._counters.add("retried")
                     self._tenant_counters(tenant).add("retried")
@@ -705,6 +784,8 @@ class Server:
                                "serve.arbiter_in_use_high_water").value,
                            "waits": reg.counter(
                                "serve.arbiter_waits").value},
+               "pod": {"paused": self.pod_paused(),
+                       "lost_peer": self._pod_lost},
                "totals": self._counters.snapshot(),
                "tenants": {}}
         for name in reg.names():
@@ -738,6 +819,9 @@ class Server:
             self._cond.notify_all()
         for th in self._threads:
             th.join()
+        for h in self._pw_handles:
+            _podwatch.remove_callback(h)   # a closed server must not
+            #                                pause/resume from beyond
         if self.warm_dir is not None:
             # the warm tally covers THIS server's lifetime; the cache
             # stays attached (artifacts keep serving), only the

@@ -126,6 +126,7 @@ from bolt_tpu import engine as _engine
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.obs.trace import clock as _clock
 from bolt_tpu.parallel import multihost as _multihost
+from bolt_tpu.parallel import podwatch as _podwatch
 from bolt_tpu.utils import iter_record_blocks, prod
 
 # ---------------------------------------------------------------------
@@ -1343,6 +1344,29 @@ def _acquire(sem, stop):
     return False
 
 
+def _pod_sync(x, pod, phase, slab=None):
+    """``block_until_ready`` with the pod watchdog armed (ISSUE 11).
+
+    Single-process (``pod=False``) this is a plain block.  On a pod the
+    value may depend on a cross-host collective a DEAD peer will never
+    complete: the watchdog first polls readiness
+    (``podwatch.wait_ready`` — a latched dead peer raises the pointed
+    ``PeerLostError`` instead of hanging this survivor in the runtime),
+    then blocks for the value, classifying any transport failure
+    (gloo connection closed — the fast shape of peer death) into the
+    same ``PeerLostError`` via ``podwatch.reraise``."""
+    if not pod:
+        jax.block_until_ready(x)
+        return
+    _podwatch.wait_ready(x, phase=phase, slab=slab)
+    try:
+        jax.block_until_ready(x)
+    except _podwatch.PeerLostError:
+        raise
+    except Exception as exc:          # noqa: BLE001 — classified
+        _podwatch.reraise(exc, phase=phase, slab=slab)
+
+
 def _multi_comps(specs):
     """Canonical component tuple for a fused multi-stat spec list —
     ONE 'moments' triple serves every mean/var/std member, 'min'/'max'
@@ -1432,6 +1456,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     resume_records = 0
     ck_state = None
     ck_fp = None
+    ck_remap = None
     if ck_dir is not None:
         from bolt_tpu import checkpoint as _ckptlib
         if mspec is not None and \
@@ -1455,13 +1480,22 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
         # the MESH's multiprocess answer, not the runtime's: a
         # process-local mesh inside a multi-process runtime checkpoints
         # single-process (its peers are elsewhere; a barrier would hang)
+        ck_info = {}
         got_ck = _ckptlib.stream_load(ck_dir, ck_fp,
-                                      multiprocess=mspec is not None)
+                                      multiprocess=mspec is not None,
+                                      info=ck_info)
         if got_ck is not None:
             start_slab, resume_records, ck_state = got_ck
+            # topology remap (shrink-and-resume): the checkpoint was cut
+            # by a different pod width; the adopted state is the
+            # replicated global fold, and the remap is recorded in every
+            # subsequent checkpoint this run writes
+            ck_remap = ck_info.get("remapped_from")
             _engine.record_stream_resume()
             _obs.event("stream.resume", slabs=start_slab,
-                       records=resume_records)
+                       records=resume_records,
+                       **({"remapped_from": ck_remap}
+                          if ck_remap is not None else {}))
     ranges = source.slab_ranges()[start_slab:] \
         if source.kind == "callback" else None
     total_slabs = len(ranges) if ranges is not None else None
@@ -1740,13 +1774,16 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
 
     def _confirm_oldest():
         """Sync the OLDEST unconfirmed pair partial (normally long
-        retired, ~free) and release its ring permits + arbiter bytes."""
+        retired, ~free) and release its ring permits + arbiter bytes.
+        On a pod the sync rides the watchdog: a partial whose
+        collective a dead peer will never complete raises the pointed
+        PeerLostError instead of hanging this survivor."""
         nonlocal compute, confirmed
         cov, ref, nb = pending_sync.popleft()
         ssp = _obs.begin("stream.sync", slabs=cov)
         t0 = _clock()
         try:
-            jax.block_until_ready(ref)
+            _pod_sync(ref, mspec is not None, "slab-partial sync")
         finally:
             _obs.end(ssp)
         compute += _clock() - t0
@@ -1774,7 +1811,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
         if pending_sync:
             _confirm_oldest()
         elif pend is not None and pend_bytes:
-            jax.block_until_ready(pend)
+            _pod_sync(pend, mspec is not None, "unpaired-partial sync")
             lease.release(pend_bytes)
             pend_bytes = 0
 
@@ -1788,11 +1825,21 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             fold = _make_fold(terminal, rfunc, comps, mesh, part)
         fold.push(part)
 
-    def _write_checkpoint():
+    def _write_checkpoint(abort=False):
         """Persist the retired-slab watermark + fold state: drain the
         async window first (permits and arbiter bytes release — the
         persisted state must cover exactly the retired slabs), pull the
-        value-shaped partials to host, write atomically."""
+        value-shaped partials to host, write atomically.
+
+        ``abort=True`` is the failure-path write.  On a POD it skips
+        the rendezvous barriers (peers may be dead or at other
+        watermarks) and the meta advances only forward
+        (``stream_save(rendezvous=False)``); the drain above still
+        runs WATCHDOG-guarded, so the write only lands when every
+        retired slab's collective actually completed — i.e. exactly
+        when the abort watermark is rendezvous-consistent.  A partial
+        hung on the dead peer raises PeerLostError out of the drain
+        and the caller falls back to the last periodic checkpoint."""
         while pending_sync:
             _confirm_oldest()
         state = (list(fold.levels) if fold is not None else [], pend)
@@ -1800,10 +1847,14 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                          slabs=start_slab + nslabs)
         t0 = _clock()
         try:
-            jax.block_until_ready(state)
+            _pod_sync(state, mspec is not None, "checkpoint drain")
             nb = _ckptlib.stream_save(ck_dir, ck_fp, start_slab + nslabs,
                                       done_records, state,
-                                      multiprocess=mspec is not None)
+                                      multiprocess=mspec is not None,
+                                      rendezvous=not (abort
+                                                      and mspec
+                                                      is not None),
+                                      remap_from=ck_remap)
             _engine.record_checkpoint(nb, _clock() - t0)
             if csp is not None:
                 csp.set(bytes=nb)
@@ -1830,6 +1881,10 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                 csp = _obs.begin("stream.compute",
                                  slab=start_slab + slab_i)
                 _chaos.hit("stream.dispatch")
+                if mspec is not None:
+                    # the pod collective seam: this dispatch enqueues a
+                    # cross-host rendezvous on every process
+                    _chaos.hit("multihost.collective")
                 try:
                     with warnings.catch_warnings():
                         # backends without donation (the CPU dev mesh)
@@ -1839,25 +1894,37 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         warnings.filterwarnings(
                             "ignore",
                             message="Some donated buffers were not usable")
-                        if pend is None:
-                            prog = _slab_program(source, terminal,
-                                                 buf.shape, ddof, rfunc,
-                                                 comps=comps,
-                                                 sharded=mspec is not None)
-                            pend = prog(buf)
-                            pend_bytes = slab_bytes
-                        else:
-                            # level-0 fold fused into the slab dispatch
-                            prog = _slab_program(source, terminal,
-                                                 buf.shape, ddof, rfunc,
-                                                 fused=True, comps=comps,
-                                                 sharded=mspec is not None)
-                            pairp = prog(buf, pend)
-                            pend = None
-                            _fold_push(pairp)
-                            pending_sync.append(
-                                (2, pairp, pend_bytes + slab_bytes))
-                            pend_bytes = 0
+                        try:
+                            if pend is None:
+                                prog = _slab_program(
+                                    source, terminal, buf.shape, ddof,
+                                    rfunc, comps=comps,
+                                    sharded=mspec is not None)
+                                pend = prog(buf)
+                                pend_bytes = slab_bytes
+                            else:
+                                # level-0 fold fused into the dispatch
+                                prog = _slab_program(
+                                    source, terminal, buf.shape, ddof,
+                                    rfunc, fused=True, comps=comps,
+                                    sharded=mspec is not None)
+                                pairp = prog(buf, pend)
+                                pend = None
+                                _fold_push(pairp)
+                                pending_sync.append(
+                                    (2, pairp, pend_bytes + slab_bytes))
+                                pend_bytes = 0
+                        except _podwatch.PeerLostError:
+                            raise
+                        except Exception as exc:  # noqa: BLE001
+                            if mspec is None:
+                                raise
+                            # a dead peer fails the collective FAST on
+                            # localhost TCP (gloo closes the socket) —
+                            # classify into the pointed PeerLostError
+                            # naming the peer and the in-flight slab
+                            _podwatch.reraise(exc, phase="slab program",
+                                              slab=start_slab + slab_i)
                     # counted INSIDE the try, right after the fold state
                     # absorbed the slab: the abort-path checkpoint below
                     # keys its watermark off nslabs, and a watermark
@@ -1891,20 +1958,27 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                 _fold_push(pend)
                 pend = None
         except BaseException:
-            # the run is failing (uploader death, source error, a
-            # chaos-injected fault): persist the retired-slab watermark
-            # FIRST, so the next run over this source resumes from here
-            # instead of from the last periodic checkpoint — best
-            # effort, never masking the original exception.  NOT on a
-            # multi-process mesh: peers can fail at different
-            # watermarks, and the abort-time write has no rendezvous —
-            # only the periodic checkpoints (barrier-consistent across
-            # the pod) are trustworthy resume points there.
-            if ck_dir is not None and nslabs and mspec is None:
+            # the run is failing (uploader death, source error, peer
+            # loss, a chaos-injected fault): persist the retired-slab
+            # watermark FIRST, so the next run over this source resumes
+            # from here instead of from the last periodic checkpoint —
+            # best effort, never masking the original exception.  On a
+            # POD the abort write skips the rendezvous (peers may be
+            # dead) and lands only when the watchdog-guarded drain
+            # proves every retired slab's collective completed — the
+            # abort watermark is then rendezvous-consistent by
+            # construction, and the fold partials are replicated
+            # global values any surviving process can resume from
+            # (stream_save(rendezvous=False); the PR 9 carve-out that
+            # skipped pods entirely is gone).
+            if ck_dir is not None and nslabs:
                 try:
-                    _write_checkpoint()
+                    _write_checkpoint(abort=True)
                 except Exception:       # noqa: BLE001 — the original
-                    pass                # failure is the story
+                    pass                # failure is the story (a drain
+                #                         hung on the dead peer falls
+                #                         back to the last periodic
+                #                         checkpoint)
             raise
         finally:
             stop.set()
@@ -1936,8 +2010,20 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                 n, mu, m2 = fold.result()
                 out = _finalise_program(terminal, mu.shape, mu.dtype,
                                         ddof, mesh)(n, mu, m2)
-            # the ONE synchronisation point of the whole run
-            jax.block_until_ready(out)
+            # the ONE synchronisation point of the whole run (pod runs
+            # sync through the watchdog: a tail collective hung on a
+            # dead peer raises PeerLostError, never an infinite wait)
+            _pod_sync(out, mspec is not None, "final result sync")
+        except BaseException:
+            # same abort-watermark contract as the main loop: the fold
+            # state covers every retired slab, so a failure here still
+            # leaves the best possible resume point
+            if ck_dir is not None and nslabs:
+                try:
+                    _write_checkpoint(abort=True)
+                except Exception:       # noqa: BLE001
+                    pass
+            raise
         finally:
             _obs.end(fsp)
         if ck_dir is not None:

@@ -148,6 +148,11 @@ def pipelines(mesh=None, nkeys=16):
     stream11 = bolt.fromcallback(lambda idx: x11[idx], (k, 8), mesh,
                                  dtype=np.float32, chunks=max(1, k // 4),
                                  per_process=True)
+    x12 = (np.arange(k * 8, dtype=np.int64) % 8).astype(
+        np.float32).reshape(k, 8)
+    stream12 = bolt.fromcallback(lambda idx: x12[idx], (k, 8), mesh,
+                                 dtype=np.float32, chunks=max(1, k // 4),
+                                 per_process=True)
     return [
         ("1 map->sum", bolt.array(np.ones((k, 8, 4), np.float32),
                                   mesh).map(ADD1)),
@@ -166,6 +171,7 @@ def pipelines(mesh=None, nkeys=16):
         ("9 serve_multitenant", stream9.map(ADD1)),
         ("10 stream_resume", stream10.map(ADD1)),
         ("11 multihost_stream", stream11.map(ADD1)),
+        ("12 multihost_resume", stream12.map(ADD1)),
     ]
 
 
@@ -421,6 +427,74 @@ def check_configs(mesh=None):
                          "OK" if ok11 else "MISMATCH"))
                 failed = failed or not ok11
                 shutil.rmtree(out11, ignore_errors=True)
+        if name.startswith("12"):
+            # the pod fault-tolerance gate (ISSUE 11): kill -9 of ONE
+            # process in a 3-process cluster must (a) raise the
+            # pointed PeerLostError on EVERY survivor — watchdog (and
+            # barrier conversion) within 2x BOLT_POD_TIMEOUT, (b)
+            # reform 3->2 and resume BIT-IDENTICALLY to the unkilled
+            # 2-process run (sum AND fused stats via the pod
+            # abort-path checkpoint), (c) leave ZERO stale checkpoint
+            # files and ZERO leaked spans; and a serving tenant on a
+            # pod must fail its in-flight future with PeerLostError,
+            # read ZERO leaked arbiter bytes after the abort, and
+            # drain/resume admission around the reform.
+            import shutil as _sh12
+            import tempfile as _tf12
+            if "jax_cpu_collectives_implementation" not in getattr(
+                    jax.config, "values", {}):
+                print("   multihost_resume gate SKIPPED: no CPU "
+                      "cross-process collective transport on this jax")
+                continue
+            mh = _load_mh_harness()
+            try:
+                r12 = mh.run_reform_bench()
+                base12 = _tf12.mkdtemp(prefix="bolt-bench-servepod-")
+                res12, out12, rcs12 = mh.run_cluster(
+                    "serve_pod", nproc=2, devs=1, timeout=200,
+                    tolerate={1},
+                    env={"BOLT_POD_TIMEOUT": 2, "BOLT_MH_HARD_EXIT": "1",
+                         "BOLT_POD_HB_DIR": os.path.join(base12, "hb")},
+                    worker_env={1: {"BOLT_CHAOS":
+                                    "stream.upload:5:kill"}})
+            except RuntimeError as exc:
+                print("   multihost_resume cluster FAILED: %s" % exc)
+                failed = True
+            else:
+                sp12 = res12[0]
+                ok12 = (r12["peer_lost_everywhere"]
+                        and r12["barrier_peerlost"]
+                        and r12["detection_s"] <= 2 * r12["pod_timeout"]
+                        and r12["barrier_s"] <= 2 * r12["pod_timeout"]
+                        and r12["bit_identical"]
+                        and r12["sum_resumes"] >= 2
+                        and r12["stats_resumes"] >= 2
+                        and r12["stale_checkpoint_files"] == []
+                        and r12["leaked_spans"] == 0
+                        and sp12["future_error"] == "PeerLostError"
+                        and sp12["arbiter_bytes_after_abort"] == 0
+                        and sp12["pod_paused"] and sp12["pod_resumed"]
+                        and sp12["leaked_spans"] == 0)
+                print("   3->2 kill -9: PeerLostError on every survivor "
+                      "%s (detection %.2fs, barrier %.4fs, deadline "
+                      "%.1fs) | reform %.2fs + resume %.2fs, "
+                      "bit-identical %s (sum resumes %d, stats resumes "
+                      "%d) | stale ckpt files %s | leaked spans %d | "
+                      "serve: future=%s arbiter_bytes=%d "
+                      "paused/resumed=%s/%s -> %s"
+                      % (r12["peer_lost_everywhere"], r12["detection_s"],
+                         r12["barrier_s"], r12["pod_timeout"],
+                         r12["reform_s"], r12["resume_s"],
+                         r12["bit_identical"], r12["sum_resumes"],
+                         r12["stats_resumes"],
+                         r12["stale_checkpoint_files"],
+                         r12["leaked_spans"], sp12["future_error"],
+                         sp12["arbiter_bytes_after_abort"],
+                         sp12["pod_paused"], sp12["pod_resumed"],
+                         "OK" if ok12 else "MISMATCH"))
+                failed = failed or not ok12
+                _sh12.rmtree(out12, ignore_errors=True)
+                _sh12.rmtree(base12, ignore_errors=True)
     obs.disable()
     return 1 if failed else 0
 
@@ -912,6 +986,38 @@ def main():
                               wall11, "exact*" if ok11 else "MISMATCH"))
         _sh11.rmtree(out11, ignore_errors=True)
         _sh11.rmtree(out11s, ignore_errors=True)
+
+    # ---- config 12: pod fault tolerance (ISSUE 11) -------------------
+    # kill -9 of one process in a 3-process cluster: every survivor
+    # raises PeerLostError (watchdog within 2x BOLT_POD_TIMEOUT),
+    # reforms onto the 2 survivors and resumes from the rendezvous-
+    # consistent checkpoint.  "local s" is the clean 2-process run of
+    # the same workload, "tpu s" the RECOVERY wall (learn -> barrier
+    # probe -> reform -> resume); the gate is recovery < 2.0x clean
+    # plus bit-identity to the unkilled run.
+    try:
+        r12 = mh.run_reform_bench()
+    except RuntimeError as exc:
+        print("   multihost_resume SKIPPED: %s" % exc, file=sys.stderr)
+    else:
+        ok12 = (r12["peer_lost_everywhere"] and r12["bit_identical"]
+                and r12["detection_s"] <= 2 * r12["pod_timeout"]
+                and r12["recovery_over_clean"] < 2.0
+                and r12["stale_checkpoint_files"] == []
+                and r12["leaked_spans"] == 0)
+        print("   multihost_resume: victim rc %s, detection %.2fs "
+              "(deadline %.1fs), reform %.2fs, resume %.2fs — recovery "
+              "%.3fs vs clean %.3fs (%.2fx, gate < 2.0x), resumes "
+              "sum/stats %d/%d, bit-identical %s"
+              % (r12["victim_rc"], r12["detection_s"],
+                 r12["pod_timeout"], r12["reform_s"], r12["resume_s"],
+                 r12["recovery_s"], r12["clean_s"],
+                 r12["recovery_over_clean"], r12["sum_resumes"],
+                 r12["stats_resumes"], r12["bit_identical"]),
+              file=sys.stderr)
+        rows.append(_progress("12 multihost_resume 3->2", r12["clean_s"],
+                              r12["recovery_s"],
+                              "exact*" if ok12 else "MISMATCH"))
 
     print("%-26s %10s %10s %9s  %s" % ("config", "local s", "tpu s", "speedup", "parity"))
     for name, lt, tt, parity in rows:

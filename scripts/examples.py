@@ -325,6 +325,29 @@ def main():
         print("  (pod example skipped: %s)" % exc)
 
     # ------------------------------------------------------------------
+    section("8h. survive a pod member loss: shrink-and-resume")
+    # the ISSUE-11 outage drill on a REAL 3-process localhost cluster:
+    # one member is SIGKILLed mid-stream; every survivor raises the
+    # pointed PeerLostError (liveness watchdog, never a hang), reforms
+    # onto the 2 survivors (multihost.reform) and RESUMES from the
+    # rendezvous-consistent checkpoint — bit-identical to the unkilled
+    # 2-process baseline, with recovery bounded against its wall.
+    try:
+        _r = _mh.run_reform_bench()
+        assert _r["peer_lost_everywhere"] and _r["barrier_peerlost"]
+        assert _r["victim_rc"] == -9
+        assert _r["bit_identical"]
+        assert _r["sum_resumes"] >= 2 and _r["stats_resumes"] >= 2
+        assert _r["stale_checkpoint_files"] == []
+        print("  victim killed (rc %d); survivors raised PeerLostError "
+              "in %.2fs (deadline %.1fs), reformed 3->2 in %.2fs and "
+              "resumed bit-identically — recovery %.2fx the clean wall"
+              % (_r["victim_rc"], _r["detection_s"], _r["pod_timeout"],
+                 _r["reform_s"], _r["recovery_over_clean"]))
+    except RuntimeError as exc:
+        print("  (pod fault example skipped: %s)" % exc)
+
+    # ------------------------------------------------------------------
     section("9. time-series pipeline: detrend -> zscore -> PCA")
     # per-pixel calcium-imaging-style workflow: remove each pixel's slow
     # drift, standardise, then find the dominant temporal components —

@@ -39,12 +39,30 @@ def free_port():
         return s.getsockname()[1]
 
 
+def free_ports(n):
+    """``n`` DISTINCT free ports (all bound simultaneously before any
+    is released — sequential ``free_port`` calls tend to hand the same
+    just-released port back, and a reform coordinator reusing the old
+    cluster's port would connect the survivors to the ORPHANED old
+    service instead of the new one)."""
+    import socket
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 # ---------------------------------------------------------------------
 # the parent side
 # ---------------------------------------------------------------------
 
 def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
-                worker_env=None, expect_dead=False, out_dir=None):
+                worker_env=None, expect_dead=False, out_dir=None,
+                tolerate=()):
     """Stand up an ``nproc``-process cluster and run ``payload`` in
     every process.  Returns ``(results, out_dir, rcs)`` where
     ``results`` is the list of per-process result dicts (``None`` for a
@@ -54,10 +72,15 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
     ``{pid: {...}}`` per-worker overlay (how the fault tests arm
     ``BOLT_CHAOS`` on ONE process).  With ``expect_dead=False`` a
     worker death while peers still run raises the pointed
-    ``RuntimeError``."""
+    ``RuntimeError``.  ``tolerate`` names pids whose death is the
+    SCENARIO (the reform tests kill one worker and expect the
+    survivors to detect it, reform and finish): a tolerated death
+    neither terminates the survivors nor fails the run — its result
+    slot is ``None`` and its exit code lands in ``rcs``."""
     own_dir = out_dir is None
     if own_dir:
         out_dir = tempfile.mkdtemp(prefix="bolt-mh-")
+    tolerate = set(tolerate)
     base = dict(os.environ)
     base.pop("BOLT_CHAOS", None)         # never inherit a stale arming
     base.update({
@@ -82,13 +105,32 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
             env=e, stdout=log, stderr=subprocess.STDOUT))
     rcs = [None] * nproc
     deadline = time.time() + timeout
+    released = False
     try:
         while any(rc is None for rc in rcs):
             for pid, p in enumerate(procs):
                 if rcs[pid] is None:
                     rcs[pid] = p.poll()
+            if not released:
+                # the EXIT BARRIER: a worker that finishes first must
+                # not tear the coordination service down under a peer
+                # still mid-payload (the peer's error-poll thread
+                # aborts the process on "service unavailable").
+                # Workers hold their teardown until this parent-side
+                # release lands — written once every worker the
+                # scenario expects to SURVIVE has durably produced its
+                # result (or already exited).
+                if all(rcs[pid] is not None
+                       or os.path.exists(os.path.join(
+                           out_dir, "result.%d.json" % pid))
+                       for pid in range(nproc) if pid not in tolerate):
+                    rel = os.path.join(out_dir, "release")
+                    with open(rel + ".tmp", "w") as f:
+                        f.write("1")
+                    os.replace(rel + ".tmp", rel)
+                    released = True
             bad = [pid for pid, rc in enumerate(rcs)
-                   if rc is not None and rc != 0]
+                   if rc is not None and rc != 0 and pid not in tolerate]
             if bad and any(rc is None for rc in rcs):
                 # a peer is gone: survivors will block in the next
                 # cross-host collective forever.  Short grace (they may
@@ -142,6 +184,8 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
             results.append(None)
     if not expect_dead:
         for pid, rc in enumerate(rcs):
+            if pid in tolerate:
+                continue              # its death IS the scenario
             if rc != 0 or results[pid] is None:
                 with open(os.path.join(out_dir, "worker.%d.log" % pid),
                           "rb") as f:
@@ -423,11 +467,222 @@ def payload_bench(pid):
     return res
 
 
+def payload_reform(pid):
+    """The ISSUE-11 acceptance payload: pod fault tolerance end to end.
+
+    With ``BOLT_CHAOS`` armed on ONE worker (the victim), each
+    SURVIVOR: (1) catches the watchdog's ``PeerLostError`` from the
+    killed checkpointed streamed sum — named dead peer, no hang; (2)
+    proves the WATCHDOG BARRIER converts too (``multihost.barrier`` →
+    ``PeerLostError`` within 2× the deadline); (3)
+    ``multihost.reform``'s onto the survivors (coordinator port from
+    ``BOLT_MH_REFORM_PORT``); (4) RESUMES the sum on the shrunk mesh
+    from the 3-process checkpoint (topology remap — the fold partials
+    are replicated global values); then (5) runs a checkpointed fused
+    ``stats("sum","var")`` on the reformed pod through an injected
+    abort + resume — the pod ABORT-path checkpoint write
+    (``stream_save(rendezvous=False)``) proven end to end.  Run
+    without chaos (any nproc) both pipelines stream clean — the
+    reference/baseline leg."""
+    import time as _time
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import _chaos, engine, obs
+    from bolt_tpu import checkpoint as ckptlib
+    from bolt_tpu.parallel import multihost, podwatch
+    from bolt_tpu.obs.trace import clock
+
+    out = os.environ["BOLT_MH_OUT"]
+    ckroot = os.environ["BOLT_MH_CKPT"]
+    n = int(os.environ.get("BOLT_MH_NKEYS", "96"))
+    chunks = int(os.environ.get("BOLT_MH_CHUNKS", "12"))
+    vdim = int(os.environ.get("BOLT_MH_VDIM", "8"))
+    pace = float(os.environ.get("BOLT_MH_PACE", "0"))
+    x = _crafted(n, vdim)
+    ck_sum = os.path.join(ckroot, "sum")
+    res = {"pid": pid, "start_nproc": multihost.process_count()}
+    obs.clear()
+    obs.enable()
+
+    def loader(idx):
+        if pace:
+            _time.sleep(pace)         # emulated storage-fetch latency
+        return x[idx]
+
+    def make_sum():
+        src = bolt.fromcallback(loader, (n, vdim), _mesh(),
+                                dtype=np.float32, chunks=chunks,
+                                checkpoint=ck_sum, per_process=True)
+        return src.map(ADD1).sum()
+
+    ec0 = engine.counters()
+    t0 = clock()
+    try:
+        s = make_sum().cache()
+        res["peer_lost"] = False
+        res["wall_s"] = clock() - t0
+    except multihost.PeerLostError as exc:
+        t_caught = clock()
+        res["peer_lost"] = True
+        res["caught_peer"] = exc.peer
+        res["caught_slab"] = exc.slab
+        res["caught_phase"] = exc.phase
+        # how stale was the victim when we learned? ~the heartbeat
+        # verdict latency — the detection_seconds observable
+        deadline = podwatch.deadline() or 5.0
+        td = clock()
+        while not podwatch.dead_peers() and clock() - td < 2 * deadline:
+            _time.sleep(0.05)
+        dead = podwatch.dead_peers()
+        res["dead_peers"] = list(dead)
+        res["detection_s"] = (
+            podwatch.peers().get(dead[0], {}).get("age") if dead
+            else None)
+        # (2) a hung BARRIER converts on every survivor, within 2x the
+        # watchdog deadline (the dead peer can never arrive)
+        tb = clock()
+        try:
+            multihost.barrier("post-loss-probe")
+            res["barrier_peerlost"] = False
+        except multihost.PeerLostError:
+            res["barrier_peerlost"] = True
+        res["barrier_s"] = clock() - tb
+        res["watchdog_deadline"] = deadline
+        # (3) reform onto the survivors (rank mapping from the watch)
+        import jax
+        survivors = podwatch.alive_peers()
+        tr = clock()
+        new_pid = multihost.reform(
+            "127.0.0.1:%s" % os.environ["BOLT_MH_REFORM_PORT"],
+            num_processes=len(survivors) or
+            multihost.process_count() - 1)
+        res["reform_s"] = clock() - tr
+        res["new_pid"] = new_pid
+        res["new_nproc"] = multihost.process_count()
+        res["new_devices"] = jax.device_count()
+        # (4) resume the checkpointed sum on the shrunk mesh
+        t4 = clock()
+        s = make_sum().cache()
+        _value(s)
+        res["resume_s"] = clock() - t4
+        # recovery = everything AFTER the survivor learned of the loss
+        res["recovery_s"] = clock() - t_caught
+    np.save(os.path.join(out, "reform_sum.%d.npy" % pid), _value(s))
+    ec1 = engine.counters()
+    res["sum_resumes"] = ec1["stream_resumes"] - ec0["stream_resumes"]
+    res["sum_stale_ckpt"] = ckptlib.stream_pending(ck_sum)
+    res["arbiter_leaked"] = 0         # no server in this payload
+    # partial observations land NOW (debug breadcrumb for a stats-leg
+    # failure) — under a name the parent's exit-barrier release logic
+    # does NOT count as a finished worker
+    tmp = os.path.join(out, "partial.%d.json.tmp" % pid)
+    with open(tmp, "w") as f:
+        json.dump(res, f)
+    os.replace(tmp, os.path.join(out, "partial.%d.json" % pid))
+
+    # ---- (5) fused stats on the (possibly reformed) pod: injected
+    # abort -> pod abort-path checkpoint -> resume, bit-identical ----
+    n2, chunks2 = 128, 16             # 8 slabs; per-process shards stay
+    x2 = _crafted(n2, vdim)           # period-aligned (Welford-exact)
+    ck_st = os.path.join(ckroot, "stats")
+    if n2 % (multihost.process_count() * 8):
+        # the crafted-Welford exactness needs period-aligned per-
+        # process shards; the scenario runs this leg on <=2 processes
+        # (the reformed pod / the clean baseline) where they are
+        res["stats_skipped"] = multihost.process_count()
+        res["leaked_spans"] = obs.active_count()
+        obs.disable()
+        return res
+
+    def make_stats():
+        src = bolt.fromcallback(lambda idx: x2[idx], (n2, vdim),
+                                _mesh(), dtype=np.float32,
+                                chunks=chunks2, checkpoint=ck_st,
+                                per_process=True)
+        return src.map(ADD1).stats("sum", "var")
+
+    if multihost.process_count() > 1:
+        # every surviving process injects the SAME deterministic
+        # mid-run fault: the abort-path write (no rendezvous — the
+        # satellite fix) must leave a resumable watermark
+        _chaos.inject("stream.upload", nth=5)
+        try:
+            _value(make_stats()["sum"])
+            res["stats_died"] = None
+        except Exception as exc:      # noqa: BLE001 — recorded
+            res["stats_died"] = type(exc).__name__
+        finally:
+            _chaos.clear()
+        res["stats_ckpt_after_abort"] = ckptlib.stream_pending(ck_st)
+    st = make_stats()
+    np.save(os.path.join(out, "reform_stats_sum.%d.npy" % pid),
+            _value(st["sum"]))
+    np.save(os.path.join(out, "reform_stats_var.%d.npy" % pid),
+            _value(st["var"]))
+    ec2 = engine.counters()
+    res["stats_resumes"] = ec2["stream_resumes"] - ec1["stream_resumes"]
+    res["stats_stale_ckpt"] = ckptlib.stream_pending(ck_st)
+    res["leaked_spans"] = obs.active_count()
+    obs.disable()
+    return res
+
+
+def payload_serve_pod(pid):
+    """Serve-layer pod degradation (ISSUE 11): a Server per process
+    submits a streamed per-process pipeline; the victim is SIGKILLed
+    mid-run.  The survivor's in-flight future must FAIL with
+    ``PeerLostError`` (never hang), the arbiter must read ZERO bytes
+    after the failure (the lease returned everything), and admission
+    must drain (``pod_paused``) until a reform notification resumes
+    the queue."""
+    import time as _time
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import obs, serve
+    from bolt_tpu.parallel import multihost, podwatch
+
+    out = os.environ["BOLT_MH_OUT"]
+    n, vdim, chunks = 64, 8, 8
+    x = _crafted(n, vdim)
+    obs.clear()
+    obs.enable()
+
+    def make():
+        src = bolt.fromcallback(lambda idx: x[idx], (n, vdim), _mesh(),
+                                dtype=np.float32, chunks=chunks,
+                                per_process=True)
+        return src.map(ADD1).sum()
+
+    res = {"pid": pid, "nproc": multihost.process_count()}
+    with serve.serving(workers=1, budget_bytes=16 << 20) as sv:
+        fut = sv.submit(make(), tenant="podtest")
+        exc = fut.exception(timeout=120)
+        res["future_error"] = (type(exc).__name__ if exc is not None
+                               else None)
+        res["future_peer"] = getattr(exc, "peer", None)
+        res["arbiter_bytes_after_abort"] = \
+            sv.stats()["arbiter"]["in_use_bytes"]
+        t0 = _time.monotonic()
+        while not sv.pod_paused() and _time.monotonic() - t0 < 30:
+            _time.sleep(0.05)
+        res["pod_paused"] = sv.pod_paused()
+        # the reform notification resumes the queue (the full reform
+        # dance is payload_reform's job; here only serve's reaction is
+        # under test)
+        podwatch.notify_reform()
+        res["pod_resumed"] = not sv.pod_paused()
+    res["leaked_spans"] = obs.active_count()
+    obs.disable()
+    return res
+
+
 PAYLOADS = {
     "stream_parity": payload_stream_parity,
     "single_ref": payload_single_ref,
     "resume": payload_resume,
     "bench": payload_bench,
+    "reform": payload_reform,
+    "serve_pod": payload_serve_pod,
 }
 
 
@@ -441,6 +696,142 @@ def worker_main(pid):
         json.dump(res, f)
     os.replace(tmp, os.path.join(out, "result.%d.json" % pid))
     print("worker %d OK" % pid, flush=True)
+    # the EXIT BARRIER (see run_cluster): hold the teardown until the
+    # parent has seen every surviving worker's result — the first
+    # worker out must not kill the coordination service under a peer
+    # still mid-payload (its error-poll thread would abort the process
+    # on "service unavailable"; the coordination shutdown barrier alone
+    # does not reliably hold it on this runtime)
+    release = os.path.join(out, "release")
+    hold = time.time() + 60
+    while not os.path.exists(release) and time.time() < hold:
+        time.sleep(0.02)
+    try:
+        from bolt_tpu.parallel import multihost
+        multihost.shutdown()
+    except Exception:
+        pass
+    if os.environ.get("BOLT_MH_HARD_EXIT") == "1":
+        # a reformed worker holds dead-backend threads (the old pod's
+        # hung gloo contexts) that can wedge interpreter teardown; the
+        # result is durably on disk, so leave without ceremony
+        sys.stdout.flush()
+        os._exit(0)
+
+
+# ---------------------------------------------------------------------
+# the reform bench (bench_all config 12 / perf_regress multihost_resume)
+# ---------------------------------------------------------------------
+
+def run_reform_bench(nproc=3, nkeys=96, chunks=12, vdim=8, pace=0.25,
+                     kill_at=7, pod_timeout=2.0, timeout=420,
+                     workdir=None):
+    """The ISSUE-11 acceptance scenario, packaged for the bench
+    harness: a CLEAN ``nproc-1``-process run of the reform workload
+    (the unkilled post-shrink baseline), then an ``nproc``-process run
+    with worker 1 SIGKILLed mid-stream — every survivor must raise
+    ``PeerLostError`` (watchdog within 2× ``BOLT_POD_TIMEOUT``),
+    ``multihost.reform`` onto the survivors, and resume bit-identically
+    to the clean run.  ``recovery_s`` is the max survivor wall from
+    the moment it LEARNED of the loss to the resumed result (barrier
+    probe + reform + resume) — the gate compares it against the clean
+    run's wall (< 2.0x).  ``chunks`` must divide both the ``nproc``-
+    and ``(nproc-1)``-wide key-axis assignments.
+
+    ``pace`` (per-slab loader latency) and ``kill_at`` (the victim's
+    fatal upload) place the death MID-STREAM: the gloo sockets are
+    established by slab 0's collective and several watermarks are
+    checkpointed, so peer death surfaces as a fast transport error and
+    the resume provably skips retired slabs.  A victim killed before
+    the FIRST collective instead costs gloo's own connect timeout
+    (~30s) — bounded and converted, but not the fast path this bench
+    measures."""
+    import shutil
+    import numpy as np
+    own = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="bolt-mh-reform-")
+    env = {"BOLT_MH_NKEYS": nkeys, "BOLT_MH_CHUNKS": chunks,
+           "BOLT_MH_VDIM": vdim, "BOLT_MH_PACE": pace,
+           "BOLT_POD_TIMEOUT": pod_timeout, "BOLT_MH_HARD_EXIT": "1",
+           "BOLT_CHECKPOINT_EVERY": "1"}
+    try:
+        # -- the unkilled baseline on the post-shrink topology --------
+        out_c = os.path.join(workdir, "out-clean")
+        out_k = os.path.join(workdir, "out-kill")
+        os.makedirs(out_c, exist_ok=True)
+        os.makedirs(out_k, exist_ok=True)
+        res_c, out_c, _ = run_cluster(
+            "reform", nproc=nproc - 1, devs=1, timeout=timeout,
+            out_dir=out_c,
+            env=dict(env, BOLT_MH_CKPT=os.path.join(workdir, "ck-clean"),
+                     BOLT_POD_HB_DIR=os.path.join(workdir, "hb-clean")))
+        clean_s = max(r["wall_s"] for r in res_c)
+        ref = np.load(os.path.join(out_c, "reform_sum.0.npy"))
+        ref_ssum = np.load(os.path.join(out_c, "reform_stats_sum.0.npy"))
+        ref_svar = np.load(os.path.join(out_c, "reform_stats_var.0.npy"))
+
+        # -- the kill: nproc processes, worker 1 is the victim --------
+        port, reform_port = free_ports(2)
+        res, out, rcs = run_cluster(
+            "reform", nproc=nproc, devs=1, timeout=timeout,
+            tolerate={1}, out_dir=out_k,
+            env=dict(env, BOLT_MH_CKPT=os.path.join(workdir, "ck-kill"),
+                     BOLT_MH_PORT=port, BOLT_MH_REFORM_PORT=reform_port,
+                     BOLT_POD_HB_DIR=os.path.join(workdir, "hb-kill")),
+            worker_env={1: {"BOLT_CHAOS":
+                            "stream.upload:%d:kill" % kill_at}})
+        survivors = [r for r in res if r is not None]
+        bit = all(
+            np.array_equal(np.load(os.path.join(
+                out, "reform_sum.%d.npy" % r["pid"])), ref)
+            and np.array_equal(np.load(os.path.join(
+                out, "reform_stats_sum.%d.npy" % r["pid"])), ref_ssum)
+            and np.array_equal(np.load(os.path.join(
+                out, "reform_stats_var.%d.npy" % r["pid"])), ref_svar)
+            for r in survivors)
+        ck_kill = os.path.join(workdir, "ck-kill")
+        stale = [p for sub in ("sum", "stats")
+                 for p in glob_dir(os.path.join(ck_kill, sub))]
+        recovery_s = max(r.get("recovery_s") or 0.0 for r in survivors)
+        return {
+            "clean_s": clean_s,
+            "recovery_s": recovery_s,
+            "recovery_over_clean": recovery_s / clean_s,
+            "detection_s": max(r.get("detection_s") or 0.0
+                               for r in survivors),
+            "reform_s": max(r.get("reform_s") or 0.0
+                            for r in survivors),
+            "resume_s": max(r.get("resume_s") or 0.0
+                            for r in survivors),
+            "barrier_s": max(r.get("barrier_s") or 0.0
+                             for r in survivors),
+            "pod_timeout": float(pod_timeout),
+            "survivors": len(survivors),
+            "victim_rc": rcs[1],
+            "peer_lost_everywhere": all(r.get("peer_lost")
+                                        for r in survivors),
+            "barrier_peerlost": all(r.get("barrier_peerlost")
+                                    for r in survivors),
+            "sum_resumes": sum(r.get("sum_resumes", 0)
+                               for r in survivors),
+            "stats_resumes": sum(r.get("stats_resumes", 0)
+                                 for r in survivors),
+            "bit_identical": bool(bit),
+            "stale_checkpoint_files": stale,
+            "leaked_spans": sum(r.get("leaked_spans", 0)
+                                for r in survivors),
+        }
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def glob_dir(path):
+    """Stream-checkpoint files still under ``path`` (the zero-stale
+    gate; empty/missing dirs read clean)."""
+    import glob as _glob
+    return [os.path.basename(p) for p in
+            _glob.glob(os.path.join(path, "stream_*"))]
 
 
 # ---------------------------------------------------------------------

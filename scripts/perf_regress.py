@@ -603,6 +603,44 @@ def fam_multihost_stream():
                          "the shard_map slab program")}
 
 
+def fam_multihost_resume():
+    # the ISSUE-11 pod fault-tolerance family: kill -9 of ONE process
+    # in a REAL 3-process localhost cluster; every survivor raises the
+    # watchdog's PeerLostError, reforms onto the 2 survivors
+    # (multihost.reform) and resumes from the rendezvous-consistent
+    # checkpoint.  s_per_iter is RECOVERY — the survivors' wall from
+    # learning of the loss to the resumed bit-identical result
+    # (barrier probe + reform + resume); recovery_over_clean < 2.0 is
+    # the healthy shape (the clean run is the unkilled 2-process
+    # baseline of the same paced workload).  detection_seconds is the
+    # heartbeat verdict latency (<= 2x BOLT_POD_TIMEOUT by contract).
+    from bolt_tpu.utils import load_script
+    mh = load_script("multihost_harness")
+    r = mh.run_reform_bench()
+    nbytes = 96 * 8 * 4               # the paced workload's input pass
+    return nbytes, r["recovery_s"], {
+        "bound": "recovery",
+        "detection_seconds": round(r["detection_s"], 5),
+        "reform_seconds": round(r["reform_s"], 5),
+        "resume_seconds": round(r["resume_s"], 5),
+        "barrier_seconds": round(r["barrier_s"], 5),
+        "clean_seconds": round(r["clean_s"], 5),
+        "recovery_over_clean": round(r["recovery_over_clean"], 2),
+        "pod_timeout_seconds": r["pod_timeout"],
+        "victim_rc": r["victim_rc"],
+        "survivors": r["survivors"],
+        "resumes_sum": r["sum_resumes"],
+        "resumes_stats": r["stats_resumes"],
+        "bit_identical": r["bit_identical"],
+        "stale_checkpoint_files": len(r["stale_checkpoint_files"]),
+        "traffic": (1.0, "recovery leg: the survivors re-stream only "
+                         "the slabs past the last rendezvous-"
+                         "consistent watermark, on the SHRUNK 2-"
+                         "process mesh (topology remap); wall is "
+                         "dominated by the paced loader + the reform "
+                         "bring-up, not bytes")}
+
+
 def fam_pca_default():
     # the SAME pca program under the bolt.precision("default") scope —
     # PERF.json records both policy modes for the precision-bound
@@ -637,6 +675,7 @@ FAMILIES = [
     ("serve_multitenant", fam_serve_multitenant),
     ("stream_resume", fam_stream_resume),
     ("multihost_stream", fam_multihost_stream),
+    ("multihost_resume", fam_multihost_resume),
 ]
 
 
@@ -762,7 +801,14 @@ def main():
                     "checkpoint_bytes", "bit_identical",
                     "stale_checkpoint", "processes", "per_process_gbps",
                     "single_process_s", "aggregate_over_single",
-                    "warm_recompiles"):
+                    "warm_recompiles",
+                    # multihost_resume (ISSUE 11): the pod recovery
+                    # phase breakdown and its hygiene observables
+                    "detection_seconds", "reform_seconds",
+                    "resume_seconds", "barrier_seconds",
+                    "pod_timeout_seconds", "victim_rc", "survivors",
+                    "resumes_sum", "resumes_stats",
+                    "stale_checkpoint_files"):
             if meta.get(key) is not None:
                 entry[key] = meta[key]
         if phases:

@@ -246,6 +246,91 @@ def test_kill_one_process_raises_pointed_error():
         shutil.rmtree(ck, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------
+# ISSUE 11: pod fault tolerance — kill -9 -> PeerLostError on every
+# survivor -> reform 3->2 -> resume, on a REAL localhost cluster
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def reform():
+    """ONE 3-process kill -9 scenario (plus its clean 2-process
+    baseline) serves every reform assertion below — see
+    scripts/multihost_harness.py run_reform_bench/payload_reform."""
+    if not _HAS_GLOO:
+        pytest.skip("no CPU cross-process collective transport")
+    mh = _harness()
+    return mh.run_reform_bench()
+
+
+@needs_cluster
+def test_kill_raises_peerlost_on_every_survivor(reform):
+    """kill -9 of ONE process: every survivor raises the pointed
+    PeerLostError (no hang), the victim named by the liveness watch
+    within 2x the watchdog deadline."""
+    assert reform["victim_rc"] == -9
+    assert reform["survivors"] == 2
+    assert reform["peer_lost_everywhere"]
+    assert reform["detection_s"] <= 2 * reform["pod_timeout"], reform
+
+
+@needs_cluster
+def test_watchdog_barrier_converts_on_survivors(reform):
+    """A barrier taken next to the dead peer fails with PeerLostError
+    on every survivor — within 2x the deadline, never an infinite
+    gloo hang."""
+    assert reform["barrier_peerlost"]
+    assert reform["barrier_s"] <= 2 * reform["pod_timeout"], reform
+
+
+@needs_cluster
+def test_reform_and_resume_bit_identical(reform):
+    """multihost.reform onto the 2 survivors + resume from the
+    3-process checkpoint (topology remap) reproduces the unkilled
+    2-process run BIT for bit — for the streamed sum AND the fused
+    stats("sum","var") (whose resume rides the pod ABORT-path
+    checkpoint write)."""
+    assert reform["bit_identical"]
+    assert reform["sum_resumes"] >= 2        # one per survivor
+    assert reform["stats_resumes"] >= 2
+
+
+@needs_cluster
+def test_reform_recovery_bounded_and_clean(reform):
+    """Recovery (learn -> barrier probe -> reform -> resume) stays
+    under 2x the clean 2-process wall, and the scenario leaves no
+    stale checkpoint files and no leaked spans on any survivor."""
+    assert reform["recovery_over_clean"] < 2.0, reform
+    assert reform["stale_checkpoint_files"] == []
+    assert reform["leaked_spans"] == 0
+
+
+@needs_cluster
+def test_serve_pod_degrades_instead_of_deadlocking():
+    """A serving tenant's in-flight future FAILS with PeerLostError
+    when a pod peer dies mid-stream, the arbiter reads zero bytes
+    after the abort, and admission drains until the reform
+    notification resumes the queue."""
+    import tempfile
+    mh = _harness()
+    base = tempfile.mkdtemp(prefix="bolt-mh-servepod-")
+    try:
+        res, out, rcs = mh.run_cluster(
+            "serve_pod", nproc=2, devs=1, timeout=200, tolerate={1},
+            env={"BOLT_POD_TIMEOUT": 2, "BOLT_MH_HARD_EXIT": "1",
+                 "BOLT_POD_HB_DIR": os.path.join(base, "hb")},
+            worker_env={1: {"BOLT_CHAOS": "stream.upload:5:kill"}})
+        assert rcs[1] == -9
+        r = res[0]
+        assert r["future_error"] == "PeerLostError", r
+        assert r["future_peer"] == 1
+        assert r["arbiter_bytes_after_abort"] == 0
+        assert r["pod_paused"] and r["pod_resumed"]
+        assert r["leaked_spans"] == 0
+        shutil.rmtree(out, ignore_errors=True)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 @needs_cluster
 def test_checkpoint_resume_across_restarted_pod():
     """The full fault-tolerance loop on a pod: a clean 2-process
