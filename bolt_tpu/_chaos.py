@@ -49,6 +49,23 @@ _LOCK = threading.Lock()
 _POINTS = {}            # name -> _Spec
 _ARMED = False          # the one hot-path check
 
+# every registered seam in the package — scripts/chaos_run.py --matrix
+# sweeps this list x {raise, kill} and asserts recovery or a pointed
+# error for each; adding a chaos.hit() call site means adding it here
+SEAMS = (
+    "stream.upload",          # uploader-pool / prefetch ingest hot path
+    "stream.dispatch",        # consumer, before each slab dispatch
+    "stream.fold",            # the final pairwise fold
+    "stream.checkpoint",      # checkpoint.stream_save entry
+    "checkpoint.meta",        # between state write and meta rename
+    "checkpoint.corrupt",     # flips bytes in a just-written state file
+    "multihost.barrier",      # every named cross-process rendezvous
+    "multihost.collective",   # every pod slab dispatch
+    "podwatch.heartbeat",     # each liveness beat (kill = preemption)
+    "supervisor.elect",       # top of every supervised recovery attempt
+    "supervisor.rejoin",      # the rejoin-door handler
+)
+
 
 class ChaosError(RuntimeError):
     """The default exception an armed fault point raises."""

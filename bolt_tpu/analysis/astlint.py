@@ -106,10 +106,12 @@ _EXEMPT = {
     # the executor's window/transfer syncs, the engine's AOT plumbing,
     # and profile's timing barriers are the sanctioned sync points
     "BLT107": ("stream.py", "engine.py", "profile.py"),
-    # the three blessed concurrency homes: the uploader pool, the
-    # multi-tenant scheduler, and the pod liveness heartbeat
+    # the blessed concurrency homes: the uploader pool, the
+    # multi-tenant scheduler, the pod liveness heartbeat, and the
+    # pod recovery supervisor's driver thread
     "BLT108": ("stream.py", "serve.py",
-               os.path.join("parallel", "podwatch.py")),
+               os.path.join("parallel", "podwatch.py"),
+               os.path.join("parallel", "supervisor.py")),
     # the one blessed fault-injection home (plus tests/scripts, whose
     # whole job is to trip and observe faults)
     "BLT109": ("_chaos.py", "tests" + os.sep, "scripts" + os.sep),

@@ -613,10 +613,24 @@ def _stream_ckpt_dir(src):
     return scope[0] if scope is not None else None
 
 
+def _active_supervisor():
+    """The installed recovery supervisor, probed through
+    ``sys.modules`` so merely checking a pipeline never imports (or
+    spins up) the supervision layer."""
+    import sys
+    sup = sys.modules.get("bolt_tpu.parallel.supervisor")
+    if sup is None:
+        return None
+    return sup.active()
+
+
 def _recovery_plan(src, nproc):
     """The pod fault-tolerance plan ``explain()`` renders for a
-    multi-process stream: heartbeat cadence, watchdog deadline, and the
-    resume topology a peer loss would lead to (ISSUE 11)."""
+    multi-process stream: heartbeat cadence, watchdog deadline, the
+    resume topology a peer loss would lead to (ISSUE 11), and — when a
+    recovery supervisor is installed — the SUPERVISED contract: the
+    backoff budget, the quarantine state, and the rejoin door
+    (ISSUE 12)."""
     from bolt_tpu.parallel import podwatch as _pw
     cfg = _pw.config()
     if cfg.get("timeout"):
@@ -632,7 +646,18 @@ def _recovery_plan(src, nproc):
     else:
         resume = ("NO checkpoint dir: peer loss discards all partials "
                   "(BLT013)")
-    return "recovery plan: %s; %s" % (hb, resume)
+    plan = "recovery plan: %s; %s" % (hb, resume)
+    sup = _active_supervisor()
+    if sup is not None:
+        scfg = sup.config()
+        q = scfg.get("quarantine") or []
+        plan += ("; SUPERVISED: auto-reform (%d retries, %.3gs "
+                 "exponential backoff), rejoin door open via the %s "
+                 "transport (quiesce at a slab-boundary checkpoint, "
+                 "reform UP, resume bit-identically), quarantine %s"
+                 % (scfg["retries"], scfg["backoff"], cfg["transport"],
+                    sorted(q) if q else "empty"))
+    return plan
 
 
 def _note_pod_recovery(src, nproc, idx, diags):
@@ -669,6 +694,33 @@ def _note_pod_recovery(src, nproc, idx, diags):
             hint="stream the checkpointed run on a mesh covering "
                  "every process, or drop checkpoint=/resumable() for "
                  "this sub-mesh run"))
+
+
+def _note_supervised_source(src, nproc, idx, diags):
+    """``BLT014``: a recovery supervisor is installed (automatic
+    re-expansion is armed — ``Server(supervise=True)`` or a standalone
+    ``parallel.supervisor.Supervisor``), this pipeline streams across
+    processes, but its source is a ``fromiter`` block iterable: only a
+    per-process ``fromcallback`` loader (shared storage, global
+    coordinates) lets a REJOINED replacement process re-ingest its
+    shard of the remaining slabs, so the supervisor cannot grow the
+    pod during this run — re-expansion waits for the next
+    per-process-sourced stream."""
+    if nproc <= 1 or src.kind != "iter":
+        return
+    if _active_supervisor() is None:
+        return
+    diags.append(Diagnostic(
+        "BLT014", idx,
+        "automatic re-expansion is armed (a recovery supervisor is "
+        "installed) but this %d-process stream reads a fromiter block "
+        "iterable: a REJOINED replacement process has no way to "
+        "re-ingest its shard mid-run, so the supervisor cannot grow "
+        "the pod during this stream" % nproc,
+        hint="use fromcallback(..., per_process=True) with a shared-"
+             "storage loader (any process can then produce any shard "
+             "range), or accept that re-expansion defers to the next "
+             "per-process-sourced run"))
 
 
 def _check_stream(arr, target, stages, diags):
@@ -724,6 +776,7 @@ def _check_stream(arr, target, stages, diags):
     _note_admission(_stream_slab_bytes(src), 0, diags)
     _note_resumable(src, 0, diags)
     _note_pod_recovery(src, nproc, 0, diags)
+    _note_supervised_source(src, nproc, 0, diags)
     idle_seen = _idle_device_check(mesh, aval.shape, walk_split, 0, diags,
                                    False)
     dynamic = False

@@ -38,6 +38,9 @@ CODES = {
     "BLT013": ("warning",
                "multi-process stream has no recovery path: peer loss "
                "discards all partials"),
+    "BLT014": ("warning",
+               "supervised pod stream's source cannot serve a rejoined "
+               "process: re-expansion impossible for this run"),
 }
 
 SEVERITIES = ("error", "warning", "info")

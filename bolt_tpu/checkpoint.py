@@ -33,6 +33,7 @@ exist and the previous one still does.
 """
 
 import glob
+import hashlib
 import json
 import os
 
@@ -40,6 +41,18 @@ import numpy as np
 
 from bolt_tpu import _chaos
 from bolt_tpu.parallel import multihost as _multihost
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A stream-checkpoint state file failed its integrity digest (bit
+    rot, truncation, a torn storage layer) — refusing to resume beats
+    silently feeding a corrupt accumulator into the fold.  The message
+    names the file; delete it (or the whole checkpoint dir) to restart
+    the run from scratch, or restore the file from replicated storage.
+    Distinct from the QUIET ``None`` cases of :func:`stream_load`
+    (missing checkpoint, fingerprint drift, a kill between the two
+    atomic renames): those are expected lifecycle states, corruption
+    never is."""
 
 
 def _array_path(path):
@@ -232,6 +245,25 @@ def _decode(node, leaves):
     return leaves[node["a"]]
 
 
+def _state_digest(slabs, records, leaves):
+    """Content hash of one checkpoint's accumulator state (watermark +
+    every leaf's shape/dtype/bytes).  Recorded in the meta by
+    :func:`stream_save` and re-verified by :func:`stream_load`, so a
+    bit-rotted or truncated shard is REFUSED with a pointed error
+    instead of feeding a corrupt accumulator into the fold.  Pod fold
+    partials are psum-replicated, so every process's shard file at one
+    watermark hashes identically — process 0's meta digest validates
+    ANY adopted shard, the topology-remap path included."""
+    h = hashlib.sha256()
+    h.update(np.asarray([int(slabs), int(records)],
+                        dtype=np.int64).tobytes())
+    for leaf in leaves:
+        arr = np.ascontiguousarray(leaf)
+        h.update(repr((arr.shape, str(arr.dtype))).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def stream_save(path, fingerprint, slabs, records, state,
                 multiprocess=None, rendezvous=True, remap_from=None):
     """Persist one streamed-run checkpoint: ``slabs`` retired slabs
@@ -293,6 +325,17 @@ def stream_save(path, fingerprint, slabs, records, state,
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
     os.replace(tmp, spath)
+    try:
+        # the bit-rot seam: an armed "checkpoint.corrupt" fault flips
+        # bytes in the JUST-WRITTEN state file (simulating storage rot
+        # under the atomic rename), which stream_load's digest check
+        # must refuse pointedly; action="kill" works unchanged
+        _chaos.hit("checkpoint.corrupt")
+    except _chaos.ChaosError:
+        with open(spath, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() // 2))
+            f.write(b"\xde\xad\xbe\xef")
     if nproc > 1 and rendezvous:
         # every peer's shard file for THIS watermark exists past here —
         # only then may the meta name it
@@ -320,6 +363,11 @@ def stream_save(path, fingerprint, slabs, records, state,
     # the pod format elects process 0 as the one meta writer (abort
     # writes have no rendezvous, so every survivor writes for itself)
     if nproc == 1 or pid == 0 or not rendezvous:
+        # the digest hashes every leaf's bytes — pay for it only on
+        # the rank that actually writes the meta (pod partials are
+        # psum-replicated, so the writer's digest validates any
+        # peer's shard), and only past the advance-only abort return
+        meta["digest"] = _state_digest(slabs, records, leaves)
         _chaos.hit("checkpoint.meta")
         tmp = _smeta_path(path) + ".tmp"
         with open(tmp, "w") as f:
@@ -394,16 +442,45 @@ def stream_load(path, fingerprint, multiprocess=None, info=None):
             info["remapped_from"] = meta_nproc
     if spath is None:
         return None
+    corrupt = (
+        "stream checkpoint state file %r is corrupt (%%s); refusing "
+        "to seed the fold with it — delete the file (or the whole "
+        "checkpoint dir) to restart from scratch, or restore it from "
+        "replicated storage" % spath)
     try:
-        with np.load(spath) as z:
+        z = np.load(spath)
+    except FileNotFoundError:
+        return None                 # raced cleanup: not a checkpoint
+    except Exception as exc:        # noqa: BLE001 — an EXISTING state
+        # file that cannot even open is bit rot or truncation, never a
+        # torn write (writes are atomic-by-rename)
+        raise CheckpointCorruptError(
+            corrupt % ("unreadable npz: %s" % exc)) from exc
+    try:
+        try:
             wm = z["watermark"]
-            leaves = [z["leaf_%d" % i]
+        except Exception as exc:    # noqa: BLE001
+            raise CheckpointCorruptError(
+                corrupt % ("watermark unreadable: %s" % exc)) from exc
+        if int(wm[0]) != int(meta["slabs"]) \
+                or int(wm[1]) != int(meta["records"]):
+            return None             # meta/state from different writes
+        #                             (a kill between the two renames)
+        try:
+            leaves = [np.asarray(z["leaf_%d" % i])
                       for i in range(int(meta["leaves"]))]
-    except (OSError, KeyError, ValueError):
-        return None                 # torn/missing state: not a checkpoint
-    if int(wm[0]) != int(meta["slabs"]) \
-            or int(wm[1]) != int(meta["records"]):
-        return None                 # meta/state from different writes
+        except Exception as exc:    # noqa: BLE001 — the watermark
+            # matched this meta, so the leaves were written by the
+            # same atomic write: failing to read them is corruption
+            raise CheckpointCorruptError(
+                corrupt % ("leaf unreadable: %s" % exc)) from exc
+    finally:
+        z.close()
+    want = meta.get("digest")
+    if want is not None and _state_digest(
+            meta["slabs"], meta["records"], leaves) != want:
+        raise CheckpointCorruptError(
+            corrupt % "content digest mismatch vs the meta record")
     state = _decode(meta["structure"], leaves)
     return int(meta["slabs"]), int(meta["records"]), state
 
@@ -466,6 +543,11 @@ def stream_clear(path, multiprocess=None):
                 os.remove(p)
             except FileNotFoundError:
                 pass
+        # dead peers' heartbeat/farewell markers go with their shard
+        # files (ISSUE 12 satellite: the shared transport dir must not
+        # accumulate a dead pod's droppings)
+        from bolt_tpu.parallel import podwatch as _podwatch
+        _podwatch.sweep_dead_markers()
         return
     for p in [_smeta_path(path), _state_path(path)] + glob.glob(
             os.path.join(path, "stream_state.p*.w*.npz")):

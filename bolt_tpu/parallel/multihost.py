@@ -155,9 +155,13 @@ def is_initialized():
     return _INITIALIZED
 
 
-def reform(coordinator_address, num_processes, process_id=None):
-    """Shrink-and-resume (ISSUE 11): rebuild the distributed runtime on
-    the SURVIVORS of a peer death as a ``num_processes``-wide cluster.
+def reform(coordinator_address, num_processes, process_id=None,
+           epoch=None, init_timeout=None):
+    """Shrink-OR-GROW-and-resume (ISSUEs 11/12): rebuild the
+    distributed runtime as a ``num_processes``-wide cluster — on the
+    SURVIVORS of a peer death, or on survivors PLUS rejoined
+    replacements (the re-expansion door ``parallel.supervisor``
+    drives).
 
     ::
 
@@ -167,17 +171,27 @@ def reform(coordinator_address, num_processes, process_id=None):
             multihost.reform("10.0.0.1:8477", num_processes=2)
             ...rebuild mesh from jax.devices(), re-run the pipeline...
 
-    Every survivor calls this with the SAME fresh coordinator address;
-    ``process_id`` defaults to this process's rank among the surviving
-    old indices (the liveness watch's view — survivors all compute the
-    same mapping).  The old client/service are dropped WITHOUT the
-    shutdown barrier (it would fail against the dead task), every XLA
-    backend and jit cache is cleared (``_compat.clear_backends`` — the
-    new backend must see the new topology), the engine's executable
-    cache is dropped (old entries pin programs compiled against dead
-    backends), and the liveness watch restarts for the new epoch.
-    ``podwatch.on_reform`` subscribers (the serving layer's admission
-    drain) are notified last.  Returns the new process id."""
+    (Manual form; ``serve.Server(supervise=True)`` automates the whole
+    dance.)  Every member calls this with the SAME fresh coordinator
+    address; ``process_id`` defaults to this process's rank among the
+    surviving old indices (the liveness watch's view — survivors all
+    compute the same mapping).  The old client/service are dropped
+    WITHOUT the shutdown barrier (it would fail against the dead
+    task), every XLA backend and jit cache is cleared
+    (``_compat.clear_backends`` — the new backend must see the new
+    topology), the engine's executable cache is dropped (old entries
+    pin programs compiled against dead backends), and the liveness
+    watch restarts for the new epoch — ``epoch`` PINS it (the
+    supervisor's plan carries the value, so a REJOINED process whose
+    local counter restarted lands on the incumbents' epoch).
+    ``init_timeout`` bounds the bring-up wait (the supervisor passes a
+    short one so a second death mid-reform fails the attempt fast).
+    Stale transport markers from epochs before the previous one are
+    swept after the watch restarts (``BOLT_POD_HB_DIR`` must not grow
+    without bound across repeated reforms).  ``podwatch.on_reform``
+    subscribers (the serving layer's admission drain) are notified
+    last.  Works for a FRESH process too (the rejoiner: nothing to
+    tear down).  Returns the new process id."""
     global _INITIALIZED
     if process_id is None:
         alive = podwatch.alive_peers()
@@ -195,6 +209,13 @@ def reform(coordinator_address, num_processes, process_id=None):
     if int(num_processes) < 1:
         raise ValueError("reform num_processes must be >= 1, got %r"
                          % (num_processes,))
+    try:
+        # a FRESH process joining through the rejoin door never ran
+        # initialize(), so the CPU cross-process collective transport
+        # must be armed here too (idempotent for survivors)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     podwatch.stop(farewell=True)
     # backends first: the gloo-backed CPU client references the
     # coordination client, and that reference must drop BEFORE the
@@ -204,12 +225,15 @@ def reform(coordinator_address, num_processes, process_id=None):
     _compat.distributed_teardown(graceful=False)
     from bolt_tpu import engine as _engine
     _engine.clear()
+    kw = {} if init_timeout is None else {"init_timeout":
+                                          int(init_timeout)}
     _compat.distributed_initialize(
         coordinator_address, int(num_processes), int(process_id),
-        on_fatal=podwatch.coordination_error)
+        on_fatal=podwatch.coordination_error, **kw)
     _INITIALIZED = True
     if int(num_processes) > 1:
-        podwatch.start(int(num_processes), int(process_id))
+        podwatch.start(int(num_processes), int(process_id), epoch=epoch)
+        podwatch.sweep_stale_markers()
     podwatch.notify_reform()
     return int(process_id)
 

@@ -33,7 +33,29 @@ death into a fast, NAMED, recoverable event:
 * **reform notification**: ``multihost.reform`` (the shrink-and-resume
   door) calls :func:`notify_reform` once the runtime is rebuilt on the
   survivors; :func:`on_reform` subscribers (``bolt_tpu.serve`` drains
-  admission on peer death and resumes here) pick the pod back up.
+  admission on peer death and resumes here) pick the pod back up;
+* a **REJOIN door** (ISSUE 12): a restarted or replacement process
+  announces itself through the transport (:func:`rejoin` — an
+  epoch-agnostic marker at the transport root, because the newcomer
+  does not know the incumbents' epoch); the watch's scan fires
+  :func:`on_rejoin` subscribers (``parallel.supervisor`` reforms the
+  pod UP to the larger topology).  The supervisor's reform **plan**
+  (coordinator address, member list, new epoch) also rides the
+  transport (``plan_set``/``plan_get``), so no out-of-band agreement
+  is ever needed;
+* a **readiness rendezvous** (:func:`ready_rendezvous`) closing the
+  pre-collective death bound: the first collective dispatch of a pod
+  stream used to block in gloo's ~30s connect when a peer died before
+  ever dispatching — now every process confirms liveness over the
+  heartbeat transport right before its first dispatch, so a peer dead
+  at dispatch time raises :class:`PeerLostError` within ~2x
+  ``BOLT_POD_TIMEOUT`` instead;
+* a **quiesce gate** (:func:`request_quiesce` / :func:`quiesce_gate`):
+  the supervisor asks in-flight pod streams to stop at a
+  slab-boundary checkpoint so the pod can reform to a LARGER topology
+  mid-stream; the decision is made by process 0 and propagated through
+  the transport behind the checkpoint barrier, so every process raises
+  the same :class:`PodQuiesceError` at the same watermark.
 
 The watchdog defaults OFF single-process (``deadline()`` is ``None``
 until :func:`start` runs, and ``multihost.initialize`` only starts it
@@ -101,6 +123,17 @@ class PeerLostError(RuntimeError):
         self.phase = phase
 
 
+class PodQuiesceError(PeerLostError):
+    """A pod stream stopped deliberately at a slab-boundary checkpoint
+    because the supervisor requested a QUIESCE (a rejoined process is
+    waiting to be folded back in — ISSUE 12).  No peer is dead
+    (``peer`` is ``None``); the run's checkpoint at ``slab`` retired
+    slabs is the resume point.  Retryable exactly like a peer loss:
+    the serving layer holds the re-attempt behind the admission drain
+    until the supervisor's reform-UP completes, then the re-run
+    resumes bit-identically on the larger pod."""
+
+
 def _lost_message(peers_, phase, slab):
     who = ("process %s" % ", ".join(str(p) for p in peers_)
            if peers_ else "a pod peer")
@@ -134,6 +167,27 @@ def is_transport_error(exc):
     fast signature of a dead peer)?"""
     text = str(exc).lower()
     return any(sign in text for sign in _TRANSPORT_SIGNS)
+
+
+# SECONDARY signatures: errors a dead peer produces one step removed
+# from the transport — a failed async collective invalidates its
+# output buffers, and the NEXT dispatch consuming them raises
+# "Array has been deleted" instead of the underlying gloo error.
+# These convert to PeerLostError only when the heartbeat actually
+# latches a dead peer within the grace window (a genuine deleted-array
+# bug must stay a deleted-array bug).
+_SECONDARY_SIGNS = (
+    "array has been deleted",
+    "buffer has been deleted",
+)
+
+
+def is_secondary_sign(exc):
+    """Could ``exc`` be the one-step-removed shape of a dead peer (an
+    errored/donated buffer from a failed collective consumed by the
+    next dispatch)?"""
+    text = str(exc).lower()
+    return any(sign in text for sign in _SECONDARY_SIGNS)
 
 
 # ---------------------------------------------------------------------
@@ -216,6 +270,141 @@ class FileTransport:
             os.remove(self._bar(name, count - 2, pid))
         except OSError:
             pass
+
+    # -- the rejoin door + reform-plan channel (ISSUE 12) --------------
+    # These markers are EPOCH-AGNOSTIC (dir root): a restarted process
+    # announcing itself cannot know the incumbents' current epoch, and
+    # the reform plan is precisely how it learns the next one.
+
+    def rejoin_mark(self, ident):
+        path = os.path.join(self.path, "rejoin.%s" % _safe_ident(ident))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("1")
+        os.replace(tmp, path)
+
+    def read_rejoin_marks(self):
+        return {os.path.basename(p)[len("rejoin."):]
+                for p in glob.glob(os.path.join(self.path, "rejoin.*"))
+                if not p.endswith(".tmp")}
+
+    def rejoin_clear(self, ident):
+        try:
+            os.remove(os.path.join(self.path,
+                                   "rejoin.%s" % _safe_ident(ident)))
+        except OSError:
+            pass
+
+    def plan_set(self, gen, text):
+        path = os.path.join(self.path, "plan.g%d.json" % int(gen))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+    def plan_get(self, gen):
+        try:
+            with open(os.path.join(self.path,
+                                   "plan.g%d.json" % int(gen))) as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def plan_gens(self):
+        """Generations with a published plan (sorted)."""
+        out = []
+        for p in glob.glob(os.path.join(self.path, "plan.g*.json")):
+            try:
+                out.append(int(os.path.basename(p)[len("plan.g"):
+                                                   -len(".json")]))
+            except ValueError:
+                pass
+        return sorted(out)
+
+    # -- the quiesce gate marker (single writer: process 0) ------------
+
+    def quiesce_mark(self, watermark):
+        path = os.path.join(self.path, "quiesce.e%d.w%d"
+                            % (self.epoch, int(watermark)))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("1")
+        os.replace(tmp, path)
+
+    def quiesce_seen(self, watermark):
+        return os.path.exists(os.path.join(
+            self.path, "quiesce.e%d.w%d" % (self.epoch, int(watermark))))
+
+    # -- marker hygiene (ISSUE 12 satellite: the shared dir must not
+    # grow without bound across repeated reforms) ----------------------
+
+    def sweep_epochs(self, keep_from):
+        """Remove heartbeat/farewell/quiesce/barrier markers from
+        epochs OLDER than ``keep_from`` (the previous epoch is kept one
+        generation as a straggler grace), plus reform plans more than
+        two generations stale.  Best-effort and idempotent — every
+        reformed process calls it, removal races are benign."""
+        keep_from = int(keep_from)
+        for pat in ("hb.e*", "quiesce.e*"):
+            for p in glob.glob(os.path.join(self.path, pat)):
+                try:
+                    ep = int(os.path.basename(p).split(".", 2)[1][1:])
+                except (IndexError, ValueError):
+                    continue
+                if ep < keep_from:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+        for p in glob.glob(os.path.join(self.path, "bar", "e*")):
+            try:
+                ep = int(os.path.basename(p).split(".", 1)[0][1:])
+            except (IndexError, ValueError):
+                continue
+            if ep < keep_from:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        gens = self.plan_gens()
+        for g in gens[:-2]:
+            try:
+                os.remove(os.path.join(self.path, "plan.g%d.json" % g))
+            except OSError:
+                pass
+
+    def sweep_peer(self, pid):
+        """Remove a DEAD peer's heartbeat/farewell markers (swept
+        alongside ``checkpoint.stream_clear``'s shard sweep — a peer
+        that died mid-run leaves beats nobody will ever advance)."""
+        for p in glob.glob(os.path.join(self.path,
+                                        "hb.e*.p%d" % int(pid))) \
+                + glob.glob(os.path.join(self.path,
+                                         "hb.e*.p%d.bye" % int(pid))):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def stale_marker_count(self):
+        """Markers from epochs before the current one (the hygiene
+        observable the elastic bench gates at zero)."""
+        n = 0
+        for pat in ("hb.e*", "quiesce.e*"):
+            for p in glob.glob(os.path.join(self.path, pat)):
+                try:
+                    ep = int(os.path.basename(p).split(".", 2)[1][1:])
+                except (IndexError, ValueError):
+                    continue
+                if ep < self.epoch:
+                    n += 1
+        return n
+
+
+def _safe_ident(ident):
+    """Marker-filename-safe identity token."""
+    return "".join(ch if (ch.isalnum() or ch in "._-") else "_"
+                   for ch in str(ident)) or "anon"
 
 
 class KVTransport:
@@ -313,6 +502,83 @@ class KVTransport:
         except Exception:             # noqa: BLE001 — sweep is best-effort
             pass
 
+    # -- rejoin door / plan channel / quiesce marker (ISSUE 12).  Note
+    # the practical limit the supervisor documents: the KV store lives
+    # on the ORIGINAL coordinator, so a rejoin/plan exchange over KV
+    # only works while that process survives — pods wanting automatic
+    # re-expansion through a coordinator loss use the shared-dir
+    # transport (BOLT_POD_HB_DIR). --------------------------------------
+
+    def rejoin_mark(self, ident):
+        try:
+            self.client.key_value_set(
+                "bolt/rejoin/%s" % _safe_ident(ident), "1")
+        except Exception as exc:      # noqa: BLE001
+            self.failed = exc
+
+    def read_rejoin_marks(self):
+        try:
+            items = self.client.key_value_dir_get("bolt/rejoin/")
+        except Exception:             # noqa: BLE001 — an unanswerable
+            return set()              # store has no announcements
+        return {key.rsplit("/", 1)[1] for key, _ in items}
+
+    def rejoin_clear(self, ident):
+        try:
+            self.client.key_value_delete(
+                "bolt/rejoin/%s" % _safe_ident(ident))
+        except Exception:             # noqa: BLE001
+            pass
+
+    def plan_set(self, gen, text):
+        self.client.key_value_set("bolt/plan/g%d" % int(gen), text)
+
+    def plan_get(self, gen):
+        try:
+            items = self.client.key_value_dir_get("bolt/plan/")
+        except Exception:             # noqa: BLE001
+            return None
+        want = "g%d" % int(gen)
+        for key, val in items:
+            if key.rsplit("/", 1)[1] == want:
+                return val
+        return None
+
+    def plan_gens(self):
+        try:
+            items = self.client.key_value_dir_get("bolt/plan/")
+        except Exception:             # noqa: BLE001
+            return []
+        out = []
+        for key, _ in items:
+            try:
+                out.append(int(key.rsplit("/g", 1)[1]))
+            except (IndexError, ValueError):
+                pass
+        return sorted(out)
+
+    def quiesce_mark(self, watermark):
+        self.client.key_value_set(
+            "bolt/quiesce/e%d/w%d" % (self.epoch, int(watermark)), "1")
+
+    def quiesce_seen(self, watermark):
+        try:
+            items = self.client.key_value_dir_get(
+                "bolt/quiesce/e%d/" % self.epoch)
+        except Exception:             # noqa: BLE001
+            return False
+        want = "w%d" % int(watermark)
+        return any(key.rsplit("/", 1)[1] == want for key, _ in items)
+
+    def sweep_epochs(self, keep_from):
+        pass                          # keys are deleted behind each beat
+
+    def sweep_peer(self, pid):
+        pass
+
+    def stale_marker_count(self):
+        return 0
+
 
 def _default_transport(epoch):
     """File transport when ``BOLT_POD_HB_DIR`` names a shared dir, else
@@ -335,6 +601,7 @@ def _default_transport(epoch):
 _CB_LOCK = threading.Lock()
 _DEATH_CBS = {}                       # handle -> cb(pid)
 _REFORM_CBS = {}                      # handle -> cb()
+_REJOIN_CBS = {}                      # handle -> cb(ident)
 _CB_SEQ = [0]
 
 
@@ -363,6 +630,7 @@ class _Watch:
         self.coord_error = None       # non-fatal coordination failure
         self.beat_errors = 0
         self.barrier_counts = {}      # name -> next generation
+        self.rejoin_seen = set()      # rejoin idents already fanned out
         self.thread = threading.Thread(
             target=self._run, name="bolt-podwatch-heartbeat", daemon=True)
 
@@ -377,6 +645,7 @@ class _Watch:
                 self.transport.beat(self.pid, self.seq)
                 self.farewelled |= self.transport.read_farewells()
                 self._scan(self.transport.read())
+                self._scan_rejoins()
                 fail_since = None
             except Exception as exc:  # noqa: BLE001 — a failing beat IS
                 now = _clock()        # a signal, never a crash: peers
@@ -421,6 +690,20 @@ class _Watch:
         for pid in newly:
             _obs.event("podwatch.peer_lost", peer=pid)
             _fire_death(pid)
+
+    def _scan_rejoins(self):
+        """Fan newly-announced rejoiners out to :func:`on_rejoin`
+        subscribers, once per identity per watch instance."""
+        read = getattr(self.transport, "read_rejoin_marks", None)
+        if read is None:
+            return
+        marks = read()
+        with self.lock:
+            new = marks - self.rejoin_seen
+            self.rejoin_seen |= new
+        for ident in sorted(new):
+            _obs.event("podwatch.rejoin", ident=ident)
+            _fire_rejoin(ident)
 
     # -- queries -------------------------------------------------------
 
@@ -470,20 +753,27 @@ def _default_interval(timeout):
 
 
 def start(nproc, pid, transport=None, dir=None, interval=None,
-          timeout=None):
+          timeout=None, epoch=None):
     """Start (or restart) this process's liveness watch for an
     ``nproc``-process pod.  ``multihost.initialize`` calls this on
     every multi-process bring-up; tests call it directly with an
     explicit ``dir`` (file transport) and tight ``interval``/
-    ``timeout``.  Returns True when a watch is running (False when no
-    transport exists or the watchdog is disabled)."""
+    ``timeout``.  ``epoch`` PINS the transport epoch instead of
+    bumping the local counter — the reform plan carries it, so a
+    REJOINED process (whose local counter restarted at zero) lands on
+    the same epoch as the incumbents.  Returns True when a watch is
+    running (False when no transport exists or the watchdog is
+    disabled)."""
     global _WATCH
     timeout = _DEF_TIMEOUT if timeout is None else float(timeout)
     if timeout <= 0 or int(nproc) <= 1:
         return False
     stop()
     with _WATCH_LOCK:
-        _EPOCH[0] += 1
+        if epoch is not None:
+            _EPOCH[0] = int(epoch)
+        else:
+            _EPOCH[0] += 1
         epoch = _EPOCH[0]
         if transport is None:
             transport = (FileTransport(dir, epoch=epoch)
@@ -524,6 +814,21 @@ def stop(farewell=False):
 def active():
     """Is a liveness watch running?"""
     return _WATCH is not None
+
+
+def epoch():
+    """The current transport epoch (the running watch's, else the
+    local counter's last value — what the next default ``start`` would
+    follow)."""
+    w = _WATCH
+    return w.transport.epoch if w is not None else _EPOCH[0]
+
+
+def transport():
+    """The running watch's transport, or ``None`` (the supervisor's
+    plan/rejoin channel rides it while the watch is up)."""
+    w = _WATCH
+    return w.transport if w is not None else None
 
 
 def deadline():
@@ -639,10 +944,23 @@ def on_reform(cb):
         return h
 
 
+def on_rejoin(cb):
+    """Register ``cb(ident)`` to fire (from the watch thread) once per
+    newly-announced rejoiner (:func:`rejoin` markers on the
+    transport).  The supervisor subscribes here to drive the
+    reform-UP.  Returns a handle for :func:`remove_callback`."""
+    with _CB_LOCK:
+        _CB_SEQ[0] += 1
+        h = ("rejoin", _CB_SEQ[0])
+        _REJOIN_CBS[h] = cb
+        return h
+
+
 def remove_callback(handle):
     with _CB_LOCK:
         _DEATH_CBS.pop(handle, None)
         _REFORM_CBS.pop(handle, None)
+        _REJOIN_CBS.pop(handle, None)
 
 
 def _fire_death(pid):
@@ -653,6 +971,16 @@ def _fire_death(pid):
             cb(pid)
         except Exception:             # noqa: BLE001 — one subscriber's
             pass                      # bug must not mute the rest
+
+
+def _fire_rejoin(ident):
+    with _CB_LOCK:
+        cbs = list(_REJOIN_CBS.values())
+    for cb in cbs:
+        try:
+            cb(ident)
+        except Exception:             # noqa: BLE001
+            pass
 
 
 def notify_reform():
@@ -667,6 +995,194 @@ def notify_reform():
             cb()
         except Exception:             # noqa: BLE001
             pass
+
+
+def rejoin_reset(ident):
+    """Forget a consumed-or-deferred rejoin announcement on the
+    RUNNING watch: clear the doorbell marker and the scan's
+    once-per-identity latch, so the identity's next :func:`rejoin`
+    rings through again.  A successful growth reform restarts the
+    watch (fresh latch) — this is for the path that did NOT reform,
+    e.g. a growth deferred because the pod never went idle."""
+    w = _WATCH
+    if w is None:
+        return
+    ident = _safe_ident(ident)
+    with w.lock:
+        w.rejoin_seen.discard(ident)
+    try:
+        w.transport.rejoin_clear(ident)
+    except Exception:                 # noqa: BLE001 — marker hygiene
+        pass
+
+
+def rejoin(ident, dir=None):
+    """Announce this (restarted or replacement) process to a running
+    pod: write an epoch-agnostic REJOIN marker the incumbents' watch
+    scan picks up (:func:`on_rejoin`).  ``dir`` names the shared
+    transport directory (default ``BOLT_POD_HB_DIR``); with a watch
+    already running its transport is used instead.  The full join
+    dance (wait for the plan, reform in) is
+    ``parallel.supervisor.attach`` — this is just the doorbell."""
+    w = _WATCH
+    tr = w.transport if w is not None else None
+    if tr is None:
+        path = dir if dir is not None else _ENV_HB_DIR
+        if not path:
+            raise RuntimeError(
+                "podwatch.rejoin needs a shared transport: pass dir= "
+                "or set BOLT_POD_HB_DIR (re-expansion needs a "
+                "rendezvous medium that outlives the dead peer)")
+        tr = FileTransport(path, epoch=0)
+    tr.rejoin_mark(ident)
+    _obs.event("podwatch.rejoin_announce", ident=str(ident))
+    return tr
+
+
+def sweep_stale_markers():
+    """Transport hygiene after a reform: drop heartbeat/farewell/
+    barrier/quiesce markers from epochs older than the previous one
+    and reform plans more than two generations stale — the shared dir
+    must not grow without bound across repeated reforms (ISSUE 12
+    satellite).  No-op without a watch."""
+    w = _WATCH
+    if w is not None:
+        try:
+            w.transport.sweep_epochs(w.transport.epoch - 1)
+        except Exception:             # noqa: BLE001 — hygiene is
+            pass                      # best-effort, never a crash
+
+
+def sweep_dead_markers():
+    """Drop latched-DEAD peers' heartbeat markers (called by
+    ``checkpoint.stream_clear`` alongside its dead-shard sweep).
+    No-op without a watch or dead peers."""
+    w = _WATCH
+    if w is None:
+        return
+    for pid in w.dead_peers():
+        try:
+            w.transport.sweep_peer(pid)
+        except Exception:             # noqa: BLE001
+            pass
+
+
+# ---------------------------------------------------------------------
+# pod-run accounting + the quiesce latch (the supervisor's seams)
+# ---------------------------------------------------------------------
+
+_BUSY_LOCK = threading.Lock()
+_BUSY = [0]                           # live pod stream runs, this process
+_QUIESCE = [None]                     # reason string while requested
+
+
+def pod_enter():
+    """A pod stream run started (the executor's accounting — the
+    supervisor must not reform UP while a healthy collective schedule
+    is in flight)."""
+    with _BUSY_LOCK:
+        _BUSY[0] += 1
+
+
+def pod_exit():
+    with _BUSY_LOCK:
+        _BUSY[0] = max(0, _BUSY[0] - 1)
+
+
+def pod_busy():
+    """Live pod stream runs on this process."""
+    with _BUSY_LOCK:
+        return _BUSY[0]
+
+
+def request_quiesce(reason="rejoin"):
+    """Ask in-flight pod streams to stop at their next slab-boundary
+    checkpoint (:func:`quiesce_gate`) so the pod can reform to a
+    larger topology.  Idempotent; cleared by :func:`clear_quiesce`."""
+    _QUIESCE[0] = str(reason)
+    _obs.event("podwatch.quiesce_requested", reason=str(reason))
+
+
+def clear_quiesce():
+    _QUIESCE[0] = None
+
+
+def quiesce_requested():
+    """The active quiesce reason, or ``None``."""
+    return _QUIESCE[0]
+
+
+def quiesce_pre(watermark):
+    """Process 0's half of the quiesce decision, taken right BEFORE a
+    pod stream's periodic checkpoint at ``watermark``: publish the
+    watermark-named marker now, so the rendezvous the checkpoint
+    itself performs (shard barrier, then meta barrier) fences its
+    visibility — :func:`quiesce_gate` with ``fenced=True`` then needs
+    no second standalone barrier per checkpoint.  No-op without a
+    watch and on non-zero ranks."""
+    w = _WATCH
+    if w is not None and w.pid == 0 and _QUIESCE[0] is not None:
+        w.transport.quiesce_mark(watermark)
+
+
+def quiesce_gate(watermark, fenced=False):
+    """The slab-boundary quiesce decision, taken right AFTER a pod
+    stream's periodic checkpoint at ``watermark`` retired slabs.
+
+    Process 0 is the single decider: if ITS quiesce latch is set it
+    publishes a watermark-named marker through the transport; a
+    barrier then fences the read, so every process sees the same
+    answer at the same watermark and raises the same
+    :class:`PodQuiesceError` — nobody dispatches a collective the
+    others have abandoned.  With ``fenced=True`` the caller already
+    fenced the marker through the checkpoint's own rendezvous
+    (:func:`quiesce_pre` before ``stream_save``'s two barriers), so
+    the standalone barrier is skipped — the common per-checkpoint
+    path pays ZERO extra cross-process syncs for the gate.  A latch
+    set on a non-zero process trips at the next gate after process
+    0's own watch scans the rejoin marker (one heartbeat interval
+    behind, at most).  No-op without a watch."""
+    w = _WATCH
+    if w is None:
+        return
+    if not fenced:
+        if w.pid == 0 and _QUIESCE[0] is not None:
+            w.transport.quiesce_mark(watermark)
+        barrier("bolt_quiesce_gate")
+    if w.transport.quiesce_seen(watermark):
+        if _QUIESCE[0] is None:
+            # process 0 decided before THIS process's own watch scanned
+            # the rejoin marker: latch locally NOW, so the serving
+            # layer holds the retry instead of re-running into a pod
+            # whose peers are already tearing down for the reform (they
+            # farewelled — silent-but-alive — so the re-run's collective
+            # would hang, not fail)
+            _QUIESCE[0] = "peer quiesce at %d retired slabs" \
+                % int(watermark)
+        raise PodQuiesceError(
+            "pod quiesce at %d retired slabs (%s): this streamed run "
+            "stopped at its slab-boundary checkpoint so the pod can "
+            "reform to the larger topology; re-run to resume from the "
+            "checkpoint — bit-identically, on the re-expanded pod"
+            % (int(watermark), _QUIESCE[0] or "supervisor"),
+            slab=int(watermark), phase="quiesce gate")
+
+
+def ready_rendezvous(name="bolt_stream_ready"):
+    """Pre-collective readiness rendezvous (ISSUE 12): every pod
+    process confirms liveness over the heartbeat transport RIGHT
+    BEFORE its first collective dispatch of a run.  A peer that died
+    before dispatching never arrives and the watchdog barrier raises
+    the pointed :class:`PeerLostError` within ~2x ``BOLT_POD_TIMEOUT``
+    — instead of the survivor blocking ~30s in gloo's connect (the
+    documented pre-PR-12 bound; a peer dying in the microseconds
+    between passing this rendezvous and dispatching still pays the
+    transport timeout, now the only residual window).  No-op without
+    a watch (``BOLT_POD_TIMEOUT=0`` keeps the old bound)."""
+    if _WATCH is None:
+        return False
+    barrier(name)
+    return True
 
 
 # ---------------------------------------------------------------------
@@ -740,13 +1256,19 @@ def reraise(exc, phase="collective", slab=None, wait=True):
         raise exc
     w = _WATCH
     dead = dead_peers()
-    if not dead and not is_transport_error(exc):
+    transport = is_transport_error(exc)
+    secondary = is_secondary_sign(exc)
+    if not dead and not transport and not secondary:
         raise exc
     if not dead and w is not None and wait:
         deadline_t = _clock() + w.timeout + 2 * w.interval
         while not dead and _clock() < deadline_t:
             time.sleep(min(w.interval, 0.05))
             dead = dead_peers()
+    if not dead and not transport:
+        # a secondary sign with nobody actually dead is NOT peer loss —
+        # surface the genuine deleted-array bug untouched
+        raise exc
     raise PeerLostError(
         _lost_message(dead, phase, slab),
         peer=dead[0] if dead else None, slab=slab, phase=phase) from exc

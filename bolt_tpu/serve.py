@@ -111,6 +111,9 @@ _SCHEMA = {
     "expired": 0,              # jobs failed on their deadline= budget
     "peer_losses": 0,          # pod peer deaths observed (ISSUE 11 —
                                # admission drained until the reform)
+    "reforms": 0,              # supervised reforms driven (ISSUE 12)
+    "rejoins": 0,              # identities folded back in by reform-up
+    "supervise_seconds": 0.0,  # total pause -> resume recovery wall
 }
 
 
@@ -280,6 +283,21 @@ class DeviceArbiter:
             self._used = max(0, self._used - nbytes)
             self._grant_locked()
 
+    def resize(self, budget_bytes):
+        """Re-point the budget (degraded-capacity admission, ISSUE 12:
+        a supervised pod that shrank N→M rescales to the surviving
+        share, and BLT010 floors recompute against the new value on
+        the very next submit).  Growing re-grants queued waiters
+        immediately; shrinking never claws back granted bytes — the
+        budget simply stays over-committed until releases drain it."""
+        budget_bytes = int(budget_bytes)
+        if budget_bytes <= 0:
+            raise ValueError("arbiter budget must be positive, got %d"
+                             % budget_bytes)
+        with self._cond:
+            self.budget = budget_bytes
+            self._grant_locked()
+
     def lease(self, tenant="default"):
         return ArbiterLease(self, tenant)
 
@@ -432,7 +450,8 @@ class Server:
     full contract."""
 
     def __init__(self, workers=None, budget_bytes=None, queue_limit=None,
-                 policy="queue", weights=None, start_warm=None):
+                 policy="queue", weights=None, start_warm=None,
+                 supervise=False):
         if policy not in ("queue", "reject"):
             raise ValueError("policy must be 'queue' or 'reject', got %r"
                              % (policy,))
@@ -484,9 +503,37 @@ class Server:
         self._pod_ok = threading.Event()
         self._pod_ok.set()
         self._pod_lost = None
+        self._pod_reason = None
+        self._pause_t0 = None
         self._pw_handles = (
             _podwatch.on_peer_death(self._on_peer_death),
             _podwatch.on_reform(self._on_pod_reform))
+        # self-healing pods (ISSUE 12): supervise=True attaches a
+        # recovery supervisor — peer death still drains admission, but
+        # the reform is now DRIVEN automatically (elect → plan →
+        # multihost.reform → resume), rejoined processes re-expand the
+        # pod through the quiesce gate, and the arbiter budget is
+        # rescaled to the surviving capacity share (BLT010 floors
+        # recompute against it).  Pass an existing Supervisor (the
+        # rejoiner's attach() handle) to adopt it instead.
+        self.supervisor = None
+        self._own_supervisor = False
+        self._budget0 = None
+        self._pod_nproc0 = None
+        if supervise:
+            from bolt_tpu.parallel import multihost as _multihost
+            from bolt_tpu.parallel import supervisor as _supervisor
+            self._budget0 = self.arbiter.budget
+            n = _multihost.process_count()
+            self._pod_nproc0 = n if n > 1 else None
+            if supervise is True:
+                self.supervisor = _supervisor.Supervisor(
+                    on_pause=self._sup_pause, on_resume=self._sup_resume)
+                self._own_supervisor = True
+            else:
+                self.supervisor = supervise
+                self.supervisor.on_pause = self._sup_pause
+                self.supervisor.on_resume = self._sup_resume
         reg = _metrics.registry()
         self._counters = reg.group("serve", _SCHEMA)
         self._g_depth = reg.gauge("serve.queue_depth")
@@ -525,6 +572,57 @@ class Server:
         ``multihost.reform``)?"""
         return not self._pod_ok.is_set()
 
+    # -- the supervisor's hooks (Server(supervise=True), ISSUE 12) -----
+
+    def _sup_pause(self, reason):
+        """Supervisor hook: a recovery started (death or rejoin
+        quiesce) — drain admission exactly like a raw peer loss."""
+        if self._pod_nproc0 is None:
+            # the server may have been constructed BEFORE
+            # multihost.initialize (process_count read 1 then): the
+            # pre-loss width is still visible at pause time — capture
+            # it now, or the post-shrink resume would record the
+            # SHRUNK width as full capacity and skip the rescale
+            try:
+                from bolt_tpu.parallel import multihost as _multihost
+                n = _multihost.process_count()
+                self._pod_nproc0 = n if n > 1 else None
+            except Exception:         # noqa: BLE001 — best effort
+                pass
+        self._pod_reason = reason
+        self._pause_t0 = _clock()
+        self._pod_ok.clear()
+        _obs.event("serve.supervise_pause", reason=str(reason))
+        with self._cond:
+            self._cond.notify_all()
+
+    def _sup_resume(self, info):
+        """Supervisor hook: the reform landed — count it, rescale the
+        arbiter budget to the surviving capacity share (degraded-
+        capacity admission: BLT010 floors recompute against the new
+        budget on the next submit), and resume the queue."""
+        keys = {"rejoins": len(info.get("rejoined", ()))}
+        if not info.get("deferred"):
+            # a deferred growth resumed the pod UNTOUCHED (no reform
+            # happened — the pod never went idle for the quiesce)
+            keys["reforms"] = 1
+        if self._pause_t0 is not None:
+            keys["supervise_seconds"] = _clock() - self._pause_t0
+            self._pause_t0 = None
+        self._counters.update(**keys)
+        nproc = int(info.get("nproc") or 0)
+        if nproc > 1 and self._budget0 is not None:
+            if self._pod_nproc0 is None or nproc > self._pod_nproc0:
+                self._pod_nproc0 = nproc      # full capacity sighting
+            share = nproc / self._pod_nproc0
+            self.arbiter.resize(max(1, int(self._budget0 * share)))
+        self._pod_reason = None
+        self._pod_lost = None
+        self._pod_ok.set()
+        _obs.event("serve.supervise_resume", nproc=nproc)
+        with self._cond:
+            self._cond.notify_all()
+
     # -- submission ----------------------------------------------------
 
     def _tenant_counters(self, tenant):
@@ -560,14 +658,23 @@ class Server:
             # servers refuse pointedly, queue-policy servers apply
             # backpressure until multihost.reform resumes the pod
             if self.policy == "reject":
+                why = ("pod peer %s was lost" % self._pod_lost
+                       if self._pod_lost is not None
+                       else "supervised recovery in progress (%s)"
+                       % self._pod_reason)
                 self._reject(tenant,
-                             "admission drained: pod peer %s was lost "
-                             "and the pod has not reformed yet "
-                             "(multihost.reform resumes the queue)"
-                             % self._pod_lost)
+                             "admission drained: %s and the pod has "
+                             "not reformed yet (multihost.reform "
+                             "resumes the queue)" % why)
             while not self._pod_ok.wait(0.05):
                 if self._closing:
                     raise RuntimeError("serve.Server is closed")
+                sup = self.supervisor
+                if sup is not None and sup.failed is not None:
+                    self._reject(tenant,
+                                 "supervised recovery abandoned (%s); "
+                                 "admission stays drained until a "
+                                 "manual multihost.reform" % sup.failed)
         retries = max(0, int(retries))
         if deadline is not None:
             deadline = float(deadline)
@@ -698,14 +805,30 @@ class Server:
                     # retries= under serving) — but only once the pod
                     # reforms: hold the re-attempt behind the admission
                     # drain instead of burning the budget into a dead
-                    # pod.  Deadline, cancel AND a closing server cut
-                    # it off — close(wait=True) must terminate even
-                    # when the reform never comes.
-                    while allowed and not self._pod_ok.wait(0.05):
+                    # pod.  A latched QUIESCE holds it too — the gate
+                    # can trip BEFORE this process's own supervisor
+                    # pauses admission (process 0 decides first), and a
+                    # re-run in that window would stream into peers
+                    # already tearing down for the reform.  Deadline,
+                    # cancel AND a closing server cut it off —
+                    # close(wait=True) must terminate even when the
+                    # reform never comes.
+                    while allowed and (
+                            not self._pod_ok.wait(0.05)
+                            or _podwatch.quiesce_requested()
+                            is not None):
+                        if _podwatch.quiesce_requested() is not None:
+                            self._stop.wait(0.05)
                         if self._cancel.is_set() or self._stop.is_set() \
                                 or (deadline is not None
                                     and _clock() - fut.submitted_s
                                     > deadline):
+                            allowed = False
+                        sup = self.supervisor
+                        if sup is not None and sup.failed is not None:
+                            # the supervisor gave up (retry budget
+                            # exhausted): deliver the loss instead of
+                            # holding for a reform that never comes
                             allowed = False
                 if allowed:
                     self._counters.add("retried")
@@ -785,7 +908,15 @@ class Server:
                            "waits": reg.counter(
                                "serve.arbiter_waits").value},
                "pod": {"paused": self.pod_paused(),
-                       "lost_peer": self._pod_lost},
+                       "lost_peer": self._pod_lost,
+                       "reason": self._pod_reason,
+                       "supervised": self.supervisor is not None,
+                       "quarantine": (self.supervisor.quarantined()
+                                      if self.supervisor is not None
+                                      else []),
+                       "budget_share": (
+                           self.arbiter.budget / self._budget0
+                           if self._budget0 else 1.0)},
                "totals": self._counters.snapshot(),
                "tenants": {}}
         for name in reg.names():
@@ -822,6 +953,12 @@ class Server:
         for h in self._pw_handles:
             _podwatch.remove_callback(h)   # a closed server must not
             #                                pause/resume from beyond
+        if self.supervisor is not None:
+            if self._own_supervisor:
+                self.supervisor.close()
+            else:                          # adopted: detach our hooks,
+                self.supervisor.on_pause = None    # leave it running
+                self.supervisor.on_resume = None
         if self.warm_dir is not None:
             # the warm tally covers THIS server's lifetime; the cache
             # stays attached (artifacts keep serving), only the
@@ -844,7 +981,8 @@ _ACTIVE_LOCK = threading.Lock()
 
 
 def start(workers=None, budget_bytes=None, queue_limit=None,
-          policy="queue", weights=None, start_warm=None):
+          policy="queue", weights=None, start_warm=None,
+          supervise=False):
     """Start and install THE process server (at most one may be active
     — the arbiter is only a global budget if there is one of it).
     Returns the :class:`Server`."""
@@ -856,7 +994,8 @@ def start(workers=None, budget_bytes=None, queue_limit=None,
                 "(the device-memory budget must have one owner)")
         _ACTIVE = Server(workers=workers, budget_bytes=budget_bytes,
                          queue_limit=queue_limit, policy=policy,
-                         weights=weights, start_warm=start_warm)
+                         weights=weights, start_warm=start_warm,
+                         supervise=supervise)
         return _ACTIVE
 
 
@@ -897,7 +1036,8 @@ def submit(pipeline, tenant="default", retries=0, deadline=None):
 
 @contextlib.contextmanager
 def serving(workers=None, budget_bytes=None, queue_limit=None,
-            policy="queue", weights=None, start_warm=None):
+            policy="queue", weights=None, start_warm=None,
+            supervise=False):
     """Scoped server lifetime::
 
         with bolt_tpu.serve.serving(workers=4) as sv:
@@ -909,10 +1049,14 @@ def serving(workers=None, budget_bytes=None, queue_limit=None,
     weighted fair share (integer credits per rotation; default 1 keeps
     the plain round-robin); ``start_warm=dir`` preloads the engine's
     persistent-cache artifacts so a fresh process serves its first
-    request without a compile storm."""
+    request without a compile storm; ``supervise=True`` attaches the
+    pod recovery supervisor (``parallel.supervisor``) — peer death and
+    rejoin reform the pod automatically, held ``retries=`` re-attempts
+    resume from the checkpoint, and the arbiter budget tracks the
+    surviving capacity share."""
     sv = start(workers=workers, budget_bytes=budget_bytes,
                queue_limit=queue_limit, policy=policy, weights=weights,
-               start_warm=start_warm)
+               start_warm=start_warm, supervise=supervise)
     try:
         yield sv
     except BaseException:

@@ -1863,6 +1863,12 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
 
     for th in threads:
         th.start()
+    if mspec is not None:
+        # the supervisor must not reform the pod UP under a live
+        # collective schedule — this counter is what its quiesce
+        # drain waits on (bolt_tpu.parallel.supervisor)
+        _podwatch.pod_enter()
+    ready_done = False
     try:
         try:
             while True:
@@ -1871,6 +1877,16 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                                else None)
                 if got is None:
                     break
+                if mspec is not None and not ready_done:
+                    # pre-collective readiness rendezvous (ISSUE 12):
+                    # confirm every peer is alive over the heartbeat
+                    # transport BEFORE the first dispatch enters the
+                    # runtime — a peer that died before dispatching
+                    # raises the pointed PeerLostError within ~2x
+                    # BOLT_POD_TIMEOUT instead of this survivor
+                    # blocking ~30s in gloo's connect
+                    _podwatch.ready_rendezvous()
+                    ready_done = True
                 slab_i, (buf, slab_bytes, tsec, slab_hi) = got
                 # slab_bytes is the PROCESS-LOCAL upload size the worker
                 # acquired from the arbiter (== buf.nbytes single-process;
@@ -1951,7 +1967,22 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                 if ck_dir is not None and nslabs % ck_every == 0 \
                         and not (total_slabs is not None
                                  and nslabs >= total_slabs):
+                    if mspec is not None:
+                        # the slab-boundary QUIESCE gate (ISSUE 12): a
+                        # supervisor folding a rejoined process back in
+                        # asks running pod streams to stop HERE — the
+                        # checkpoint just written is the resume point.
+                        # Process 0 publishes its decision BEFORE the
+                        # checkpoint, whose own rendezvous barriers
+                        # fence the marker read, so every peer abandons
+                        # the same watermark (PodQuiesceError,
+                        # retryable like a peer loss) with no second
+                        # standalone barrier per checkpoint
+                        _podwatch.quiesce_pre(start_slab + nslabs)
                     _write_checkpoint()
+                    if mspec is not None:
+                        _podwatch.quiesce_gate(start_slab + nslabs,
+                                               fenced=True)
             if pend is not None:
                 # odd slab count: the unpaired tail partial joins the
                 # tree as its own leaf (deterministic — slab order only)
@@ -2045,6 +2076,8 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             return list(out)              # one jax array per member spec
         return BoltArrayTPU(out, 0, mesh)
     finally:
+        if mspec is not None:
+            _podwatch.pod_exit()
         if lease is not None:
             lease.close()       # return every outstanding budget byte
         _obs.end(run_sp)

@@ -153,6 +153,11 @@ def pipelines(mesh=None, nkeys=16):
     stream12 = bolt.fromcallback(lambda idx: x12[idx], (k, 8), mesh,
                                  dtype=np.float32, chunks=max(1, k // 4),
                                  per_process=True)
+    x13 = (np.arange(k * 8, dtype=np.int64) % 8).astype(
+        np.float32).reshape(k, 8)
+    stream13 = bolt.fromcallback(lambda idx: x13[idx], (k, 8), mesh,
+                                 dtype=np.float32, chunks=max(1, k // 4),
+                                 per_process=True)
     return [
         ("1 map->sum", bolt.array(np.ones((k, 8, 4), np.float32),
                                   mesh).map(ADD1)),
@@ -172,6 +177,7 @@ def pipelines(mesh=None, nkeys=16):
         ("10 stream_resume", stream10.map(ADD1)),
         ("11 multihost_stream", stream11.map(ADD1)),
         ("12 multihost_resume", stream12.map(ADD1)),
+        ("13 multihost_elastic", stream13.map(ADD1)),
     ]
 
 
@@ -495,6 +501,74 @@ def check_configs(mesh=None):
                 failed = failed or not ok12
                 _sh12.rmtree(out12, ignore_errors=True)
                 _sh12.rmtree(base12, ignore_errors=True)
+        if name.startswith("13"):
+            # the self-healing pod gate (ISSUE 12): kill -9 of ONE
+            # process under Server(supervise=True) must (a) shrink 3->2
+            # and RE-EXPAND 2->3 (a replacement process rejoins
+            # mid-stream) with ZERO caller intervention, (b) stay
+            # BIT-IDENTICAL to the unkilled 3-process run for every
+            # artifact (sums A/B, fused stats C), (c) finish under 2.5x
+            # the clean wall with zero leaked arbiter bytes / spans /
+            # stale checkpoints / stale transport markers, (d) flag
+            # BLT014 and render the SUPERVISED explain() plan on the
+            # live pod; and a peer dead BEFORE the first collective
+            # must raise PeerLostError within 2x BOLT_POD_TIMEOUT (the
+            # pre-collective bound, closed).
+            if "jax_cpu_collectives_implementation" not in getattr(
+                    jax.config, "values", {}):
+                print("   multihost_elastic gate SKIPPED: no CPU "
+                      "cross-process collective transport on this jax")
+                continue
+            mh = _load_mh_harness()
+            try:
+                r13 = mh.run_supervise_bench()
+                p13 = mh.run_precollective_probe()
+            except RuntimeError as exc:
+                print("   multihost_elastic cluster FAILED: %s" % exc)
+                failed = True
+            else:
+                ok13 = (r13["victim_rc"] == -9
+                        and r13["survivors"] == 2
+                        and r13["rejoined"] == 1
+                        and r13["nproc_final"] == 3
+                        and r13["detection_s"] <= 2 * r13["pod_timeout"]
+                        and r13["scenario_over_clean"] < 2.5
+                        and r13["bit_identical"]
+                        and r13["a_resumes"] >= 2
+                        and r13["b_resumes"] >= 2
+                        and r13["arbiter_bytes"] == 0
+                        and r13["leaked_spans"] == 0
+                        and r13["stale_ckpt"] == []
+                        and r13["stale_markers"] == 0
+                        and r13["blt014"]
+                        and r13["explain_supervised"]
+                        and p13["pre_peerlost"]
+                        and p13["pre_elapsed"]
+                        <= 2 * p13["pod_timeout"])
+                print("   3->2->3 supervised: victim rc %s, detection "
+                      "%.2fs (deadline %.1fs), reform %.3fs, rejoin "
+                      "%.3fs — scenario %.3fs vs clean %.3fs (%.2fx, "
+                      "gate < 2.5x), resumes A/B %d/%d, final width %d, "
+                      "budget share %.2f->%.2f, bit-identical %s | "
+                      "leaks: arbiter %d spans %d stale-ckpt %s "
+                      "stale-markers %d | BLT014 %s explain %s | "
+                      "pre-collective PeerLost %.2fs (bound %.1fs) -> %s"
+                      % (r13["victim_rc"], r13["detection_s"],
+                         r13["pod_timeout"], r13["reform_s"],
+                         r13["rejoin_s"], r13["scenario_s"],
+                         r13["clean_s"], r13["scenario_over_clean"],
+                         r13["a_resumes"], r13["b_resumes"],
+                         r13["nproc_final"],
+                         r13["budget_share_after_a"],
+                         r13["budget_share_after_b"],
+                         r13["bit_identical"], r13["arbiter_bytes"],
+                         r13["leaked_spans"], r13["stale_ckpt"],
+                         r13["stale_markers"], r13["blt014"],
+                         r13["explain_supervised"],
+                         p13["pre_elapsed"] or -1.0,
+                         2 * p13["pod_timeout"],
+                         "OK" if ok13 else "MISMATCH"))
+                failed = failed or not ok13
     obs.disable()
     return 1 if failed else 0
 
@@ -1018,6 +1092,43 @@ def main():
         rows.append(_progress("12 multihost_resume 3->2", r12["clean_s"],
                               r12["recovery_s"],
                               "exact*" if ok12 else "MISMATCH"))
+
+    # ---- config 13: self-healing pods (ISSUE 12) ---------------------
+    # kill -9 of one process under Server(supervise=True): the pod
+    # shrinks 3->2 AUTOMATICALLY (no caller intervention), a restarted
+    # replacement rejoins mid-stream and the pod re-expands 2->3.
+    # "local s" is the clean 3-process run of the same supervised
+    # workload, "tpu s" the elastic scenario wall; the gate is
+    # scenario < 2.5x clean plus bit-identity of every artifact to the
+    # unkilled run.
+    try:
+        r13 = mh.run_supervise_bench()
+    except RuntimeError as exc:
+        print("   multihost_elastic SKIPPED: %s" % exc, file=sys.stderr)
+    else:
+        ok13 = (r13["bit_identical"] and r13["rejoined"] == 1
+                and r13["nproc_final"] == 3
+                and r13["detection_s"] <= 2 * r13["pod_timeout"]
+                and r13["scenario_over_clean"] < 2.5
+                and r13["arbiter_bytes"] == 0
+                and r13["leaked_spans"] == 0
+                and r13["stale_ckpt"] == []
+                and r13["stale_markers"] == 0)
+        print("   multihost_elastic: victim rc %s, detection %.2fs "
+              "(deadline %.1fs), auto-reform %.3fs, rejoin recovery "
+              "%.3fs — scenario %.3fs vs clean %.3fs (%.2fx, gate "
+              "< 2.5x), resumes A/B %d/%d, final width %d, "
+              "bit-identical %s"
+              % (r13["victim_rc"], r13["detection_s"],
+                 r13["pod_timeout"], r13["reform_s"], r13["rejoin_s"],
+                 r13["scenario_s"], r13["clean_s"],
+                 r13["scenario_over_clean"], r13["a_resumes"],
+                 r13["b_resumes"], r13["nproc_final"],
+                 r13["bit_identical"]),
+              file=sys.stderr)
+        rows.append(_progress("13 multihost_elastic 3->2->3",
+                              r13["clean_s"], r13["scenario_s"],
+                              "exact*" if ok13 else "MISMATCH"))
 
     print("%-26s %10s %10s %9s  %s" % ("config", "local s", "tpu s", "speedup", "parity"))
     for name, lt, tt, parity in rows:

@@ -16,9 +16,22 @@ Proves the two kill-mid-run contracts end to end:
   under 1.5x the clean run (the resumed child streams only the
   remaining slabs).
 
+The **matrix** mode (ISSUE 12) sweeps EVERY registered fault seam
+(``bolt_tpu._chaos.SEAMS``) × {``raise``, ``kill``} and asserts, for
+each cell, either *recovery* (the fault is absorbed in place, or a
+re-run resumes bit-identically) or a *pointed error* (the fault
+surfaces as a named, actionable exception — never a hang, never silent
+corruption).  Seam drivers: the stream/checkpoint seams ride the
+subprocess streamed workload; the pod seams (heartbeat, barrier,
+supervisor elect/rejoin) ride a fake-peer pod fixture in a child
+process; ``multihost.collective`` rides a REAL 2-process localhost
+cluster (skipped without the CPU collective transport).  A seam added
+to ``SEAMS`` without a driver here fails its cells loudly.
+
 Usage::
 
     python scripts/chaos_run.py            # run both variants, assert
+    python scripts/chaos_run.py --matrix   # the seam x action sweep
     python scripts/chaos_run.py --child .. # internal: one streamed run
 
 ``bench_all.py`` config 10 (``stream_resume``) and the ``perf_regress``
@@ -57,14 +70,19 @@ def child_main(argv):
     """One streamed run over the canonical workload: the kill target.
     Writes the result array and a JSON sidecar (in-run wall seconds +
     fault counters) — a SIGKILLed child writes neither, which is the
-    point."""
+    point.  ``--arm seam:nth:action[,seam:nth:action...]`` arms fault
+    points programmatically (the matrix mode's multi-seam cells; the
+    single-seam ``BOLT_CHAOS`` env form still works)."""
     import jax
     import bolt_tpu as bolt
-    from bolt_tpu import engine
+    from bolt_tpu import _chaos, engine
     from bolt_tpu.obs.trace import clock
 
     args = dict(zip(argv[::2], argv[1::2]))
     ck_dir, out = args["--dir"], args["--out"]
+    for spec in filter(None, args.get("--arm", "").split(",")):
+        seam, nth, action = spec.split(":")
+        _chaos.inject(seam, nth=int(nth), action=action)
     data = _data()
 
     def loader(idx):
@@ -202,6 +220,308 @@ def run_thread_variant():
     }
 
 
+# ---------------------------------------------------------------------
+# the seam x action matrix (ISSUE 12)
+# ---------------------------------------------------------------------
+
+# where each streamed-workload seam trips (of 8 slabs): late enough
+# that a checkpoint exists, early enough that slabs remain to resume
+_STREAM_NTH = {"stream.upload": 5, "stream.dispatch": 4,
+               "stream.fold": 1, "stream.checkpoint": 3,
+               "checkpoint.meta": 3, "checkpoint.corrupt": 3}
+_POD_NTH = {"podwatch.heartbeat": 3, "multihost.barrier": 1,
+            "supervisor.elect": 1, "supervisor.rejoin": 1}
+
+
+def _run_stream_child(ck_dir, out, arm=""):
+    env = dict(os.environ)
+    env["BOLT_STREAM_UPLOAD_THREADS"] = "1"
+    env.pop("BOLT_CHAOS", None)
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "--dir", ck_dir, "--out", out, "--arm", arm],
+        env=env, capture_output=True, text=True, timeout=600)
+
+
+def pod_child_main(argv):
+    """One matrix cell of the POD seams, run in a CHILD process (the
+    kill cells SIGKILL it): a fake 2-member pod — file-transport watch
+    plus a beating fake peer — drives the seam's recovery scenario and
+    asserts the recovery semantics in raise mode.  ``BOLT_MATRIX_ARM``
+    arms the seam; the re-run (arm off) proves the clean scenario
+    completes after a kill."""
+    import threading
+    from bolt_tpu import _chaos
+    from bolt_tpu.parallel import multihost, podwatch, supervisor
+
+    seam, mode = argv[0], argv[1]
+    hb = os.environ["BOLT_MATRIX_HB"]
+    armed = os.environ.get("BOLT_MATRIX_ARM") == "1"
+    if armed:
+        _chaos.inject(seam, nth=_POD_NTH[seam], action=mode)
+    assert podwatch.start(2, 0, dir=hb, interval=0.05, timeout=0.5)
+    tr = podwatch._WATCH.transport
+    stop = threading.Event()
+
+    def beat():
+        seq = 0
+        while not stop.is_set():
+            seq += 1
+            tr.beat(1, seq)
+            for gen in range(8):
+                tr.barrier_mark("chaos_probe", gen, 1)
+            stop.wait(0.03)
+
+    th = threading.Thread(target=beat, daemon=True)
+    th.start()
+
+    def wait_for(pred, what, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while not pred():
+            if time.monotonic() > deadline:
+                raise AssertionError("%s never happened" % what)
+            time.sleep(0.02)
+
+    try:
+        if seam == "podwatch.heartbeat":
+            # the beat absorbs a raise IN PLACE: peers stay alive and
+            # the watch keeps beating (a kill lands before this check)
+            wait_for(lambda: (not armed)
+                     or _chaos.stats(seam)[0] >= _POD_NTH[seam] + 2,
+                     "post-fault heartbeats")
+            assert podwatch.dead_peers() == ()
+            assert podwatch._WATCH.beat_errors == (1 if armed else 0)
+        elif seam == "multihost.barrier":
+            orig = multihost.process_count
+            multihost.process_count = lambda: 2
+            try:
+                pointed = False
+                try:
+                    multihost.barrier("chaos_probe")
+                except _chaos.ChaosError:
+                    pointed = True     # the POINTED, named fault
+                multihost.barrier("chaos_probe")   # the retry lands
+                assert pointed == armed
+            finally:
+                multihost.process_count = orig
+        elif seam in ("supervisor.elect", "supervisor.rejoin"):
+            calls = []
+
+            def reform(addr, num_processes, process_id=None,
+                       epoch=None, init_timeout=None):
+                calls.append(int(num_processes))
+                podwatch.notify_reform()
+                return process_id
+
+            multihost.reform = reform
+            sup = supervisor.Supervisor(backoff=0.1)
+            try:
+                if seam == "supervisor.elect":
+                    # a peer death: attempt 1 trips the seam, the
+                    # backoff retry completes the reform
+                    wait_for(lambda: 1 in podwatch.alive_peers(),
+                             "fake peer alive")
+                    podwatch.mark_dead(1)
+                    wait_for(lambda: sup.stats()["reforms"] == 1,
+                             "supervised reform")
+                    assert sup.stats()["backoffs"] == \
+                        (1 if armed else 0)
+                    assert calls == [1]
+                else:
+                    # a rejoin announcement: the tripped handler DROPS
+                    # it (no thrash); the next announcement is honored
+                    wait_for(lambda: 1 in podwatch.alive_peers(),
+                             "fake peer alive")
+                    if armed:
+                        podwatch.rejoin("wX")
+                        wait_for(lambda: _chaos.stats(seam)[1] == 1,
+                                 "rejoin handler trip")
+                        time.sleep(0.3)
+                        assert sup.stats()["reforms"] == 0
+                    podwatch.rejoin("wY")
+                    wait_for(lambda: sup.stats()["reforms"] == 1,
+                             "reform-up")
+                    assert calls[-1] == 3   # i0 + i1 + the rejoiner
+            finally:
+                sup.close()
+    finally:
+        stop.set()
+        th.join()
+        podwatch.stop()
+    print("POD-CELL OK", flush=True)
+    return 0
+
+
+def _pod_cell(seam, mode, workdir):
+    """Run one pod-seam cell: the armed child (raise: asserts the
+    absorb/retry semantics in place; kill: dies AT the seam), then for
+    kill cells a clean re-run proving the scenario completes."""
+    import shutil
+    hb = os.path.join(workdir, "hb-%s-%s" % (seam.replace(".", "_"),
+                                             mode))
+
+    def run(arm):
+        env = dict(os.environ)
+        env.pop("BOLT_CHAOS", None)
+        env["BOLT_MATRIX_HB"] = hb
+        env["BOLT_MATRIX_ARM"] = "1" if arm else "0"
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--pod-child",
+             seam, mode], env=env, capture_output=True, text=True,
+            timeout=120)
+
+    os.makedirs(hb, exist_ok=True)
+    try:
+        proc = run(arm=True)
+        if mode == "raise":
+            if proc.returncode != 0:
+                return ("FAIL", "raise cell rc=%s:\n%s"
+                        % (proc.returncode, proc.stderr[-1500:]))
+            return ("recovered", "fault absorbed/retried in place")
+        if proc.returncode != -9:
+            return ("FAIL", "kill cell rc=%s (expected -9):\n%s"
+                    % (proc.returncode, proc.stderr[-1500:]))
+        shutil.rmtree(hb, ignore_errors=True)
+        os.makedirs(hb, exist_ok=True)
+        proc = run(arm=False)
+        if proc.returncode != 0:
+            return ("FAIL", "post-kill re-run rc=%s:\n%s"
+                    % (proc.returncode, proc.stderr[-1500:]))
+        return ("recovered", "died at the seam; restarted scenario "
+                             "completes")
+    finally:
+        shutil.rmtree(hb, ignore_errors=True)
+
+
+def _stream_cell(seam, mode, workdir):
+    """Run one stream/checkpoint-seam cell through the subprocess
+    streamed workload: the armed child dies (or raises out), then a
+    re-run must either RESUME bit-identically or refuse POINTEDLY
+    (checkpoint.corrupt names the rotted file)."""
+    from bolt_tpu import checkpoint as ckpt
+    tag = "%s-%s" % (seam.replace(".", "_"), mode)
+    ck = os.path.join(workdir, "ck-" + tag)
+    out = os.path.join(workdir, "out-" + tag + ".npy")
+    nth = _STREAM_NTH[seam]
+    arm = "%s:%d:%s" % (seam, nth, mode)
+    if seam == "checkpoint.corrupt" and mode == "raise":
+        # the corruption seam's raise form ROTS the just-written state
+        # under the atomic rename and lets the run continue — a later
+        # kill leaves the rotted checkpoint for the resume to refuse
+        arm += ",stream.upload:7:kill"
+    proc = _run_stream_child(ck, out, arm=arm)
+    if proc.returncode == 0:
+        return ("FAIL", "armed child was supposed to die and did not")
+    if mode == "kill" or "," in arm:
+        if proc.returncode != -9:
+            return ("FAIL", "kill child rc=%s (expected -9):\n%s"
+                    % (proc.returncode, proc.stderr[-1500:]))
+    elif "ChaosError" not in proc.stderr:
+        return ("FAIL", "raise child died WITHOUT the pointed "
+                        "ChaosError:\n%s" % proc.stderr[-1500:])
+    proc = _run_stream_child(ck, out)
+    if seam == "checkpoint.corrupt" and mode == "raise":
+        # recovery is impossible by design — the contract is the
+        # POINTED refusal naming the file, then a clean restart
+        if proc.returncode == 0:
+            return ("FAIL", "resume accepted a bit-rotted checkpoint")
+        if "CheckpointCorruptError" not in proc.stderr \
+                or "stream_state" not in proc.stderr:
+            return ("FAIL", "corrupt resume died without the pointed "
+                            "refusal:\n%s" % proc.stderr[-1500:])
+        import shutil
+        shutil.rmtree(ck, ignore_errors=True)
+        proc = _run_stream_child(ck, out)
+        if proc.returncode != 0:
+            return ("FAIL", "clean restart after the refusal failed:"
+                            "\n%s" % proc.stderr[-1500:])
+        return ("pointed", "rotted shard refused by name; clean "
+                           "restart recovers")
+    if proc.returncode != 0:
+        return ("FAIL", "resume child failed:\n%s"
+                % proc.stderr[-1500:])
+    if not np.array_equal(np.load(out), _data().sum(axis=0)):
+        return ("FAIL", "resumed result differs from the oracle")
+    if ckpt.stream_pending(ck):
+        return ("FAIL", "resumed run left a stale checkpoint")
+    return ("recovered", "re-run resumed bit-identically")
+
+
+def _collective_cell(seam, mode, workdir):
+    """multihost.collective rides a REAL 2-process localhost cluster:
+    the armed worker dies at a slab dispatch, the harness raises the
+    POINTED error naming it, and a restarted cluster RESUMES from the
+    shard checkpoint bit-identically."""
+    import jax
+    if "jax_cpu_collectives_implementation" not in getattr(
+            jax.config, "values", {}):
+        return ("skipped", "no CPU cross-process collective transport")
+    from bolt_tpu.utils import load_script
+    mh = load_script("multihost_harness")
+    ck = os.path.join(workdir, "ck-coll-" + mode)
+    env = {"BOLT_MH_CKPT": ck, "BOLT_CHECKPOINT_EVERY": "1",
+           "BOLT_POD_TIMEOUT": "2"}
+    try:
+        mh.run_cluster("resume", nproc=2, devs=1, timeout=120, env=env,
+                       worker_env={1: {"BOLT_CHAOS":
+                                       "%s:3:%s" % (seam, mode)}})
+        return ("FAIL", "armed cluster was supposed to fail and did "
+                        "not")
+    except RuntimeError as exc:
+        if "process 1 died" not in str(exc):
+            return ("FAIL", "cluster failed WITHOUT naming the dead "
+                            "process: %s" % exc)
+    res, out, _ = mh.run_cluster("resume", nproc=2, devs=1,
+                                 timeout=120, env=env)
+    if not all(r["resumes"] >= 1 for r in res):
+        return ("FAIL", "restarted cluster did not resume: %s" % res)
+    return ("pointed", "harness error names the dead process; "
+                       "restarted cluster resumes from the shard "
+                       "checkpoint")
+
+
+def run_matrix():
+    """Sweep every registered seam x {raise, kill}; assert recovery or
+    a pointed error for each cell.  Returns the process exit code."""
+    import shutil
+    from bolt_tpu import _chaos
+    workdir = tempfile.mkdtemp(prefix="bolt-chaos-matrix-")
+    cells = []
+    try:
+        for seam in _chaos.SEAMS:
+            for mode in ("raise", "kill"):
+                t0 = time.monotonic()
+                if seam in _STREAM_NTH:
+                    outcome, detail = _stream_cell(seam, mode, workdir)
+                elif seam in _POD_NTH:
+                    outcome, detail = _pod_cell(seam, mode, workdir)
+                elif seam == "multihost.collective":
+                    outcome, detail = _collective_cell(seam, mode,
+                                                       workdir)
+                else:
+                    outcome, detail = (
+                        "FAIL", "no matrix driver for this seam — a "
+                                "new chaos.hit() site needs a cell "
+                                "here")
+                cells.append((seam, mode, outcome, detail))
+                print("%-22s %-6s %-10s %5.1fs  %s"
+                      % (seam, mode, outcome,
+                         time.monotonic() - t0, detail.splitlines()[0]),
+                      flush=True)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    bad = [c for c in cells if c[2] == "FAIL"]
+    print("== matrix: %d cells, %d recovered, %d pointed, %d skipped, "
+          "%d FAILED"
+          % (len(cells),
+             sum(1 for c in cells if c[2] == "recovered"),
+             sum(1 for c in cells if c[2] == "pointed"),
+             sum(1 for c in cells if c[2] == "skipped"), len(bad)))
+    for seam, mode, _, detail in bad:
+        print("-- %s x %s:\n%s" % (seam, mode, detail))
+    return 1 if bad else 0
+
+
 def main():
     print("== thread-raise variant (in process)")
     tv = run_thread_variant()
@@ -227,4 +547,8 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         sys.exit(child_main(sys.argv[2:]))
+    if "--pod-child" in sys.argv:
+        sys.exit(pod_child_main(sys.argv[2:]))
+    if "--matrix" in sys.argv:
+        sys.exit(run_matrix())
     sys.exit(main())

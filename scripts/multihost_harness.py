@@ -39,6 +39,23 @@ def free_port():
         return s.getsockname()[1]
 
 
+def _dump_stacks(procs, grace=1.5):
+    """Ask every still-running worker to dump all thread stacks into
+    its log (faulthandler on SIGUSR1, armed in worker_main) before the
+    monitor SIGKILLs it — a wedged survivor's log otherwise says
+    nothing about WHERE it wedged."""
+    import signal
+    alive = [p for p in procs if p.poll() is None]
+    for p in alive:
+        try:
+            p.send_signal(signal.SIGUSR1)
+        except OSError:
+            pass
+    hold = time.time() + grace
+    while time.time() < hold and any(p.poll() is None for p in alive):
+        time.sleep(0.05)
+
+
 def free_ports(n):
     """``n`` DISTINCT free ports (all bound simultaneously before any
     is released — sequential ``free_port`` calls tend to hand the same
@@ -62,7 +79,7 @@ def free_ports(n):
 
 def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
                 worker_env=None, expect_dead=False, out_dir=None,
-                tolerate=()):
+                tolerate=(), extra_workers=None):
     """Stand up an ``nproc``-process cluster and run ``payload`` in
     every process.  Returns ``(results, out_dir, rcs)`` where
     ``results`` is the list of per-process result dicts (``None`` for a
@@ -76,11 +93,22 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
     SCENARIO (the reform tests kill one worker and expect the
     survivors to detect it, reform and finish): a tolerated death
     neither terminates the survivors nor fails the run — its result
-    slot is ``None`` and its exit code lands in ``rcs``."""
+    slot is ``None`` and its exit code lands in ``rcs``.
+
+    ``extra_workers`` is a ``{wid: {...env}}`` map of ADDITIONAL
+    processes spawned OUTSIDE the initial cluster (``wid >= nproc``):
+    the rejoiner of the 3→2→3 elastic scenario runs the same payload
+    but skips the bootstrap ``multihost.initialize`` (arm
+    ``BOLT_MH_REJOINER=1`` in its env) and joins later through
+    ``supervisor.attach``.  Extra workers must succeed and their
+    results are required before the exit-barrier release."""
     own_dir = out_dir is None
     if own_dir:
         out_dir = tempfile.mkdtemp(prefix="bolt-mh-")
+    else:
+        os.makedirs(out_dir, exist_ok=True)
     tolerate = set(tolerate)
+    extra_workers = dict(extra_workers or {})
     base = dict(os.environ)
     base.pop("BOLT_CHAOS", None)         # never inherit a stale arming
     base.update({
@@ -92,18 +120,25 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
     })
     if env:
         base.update({k: str(v) for k, v in env.items()})
+    wids = list(range(nproc)) + sorted(extra_workers)
+    if wids != list(range(len(wids))):
+        raise ValueError("extra_workers ids must be contiguous from "
+                         "nproc (got %s)" % sorted(extra_workers))
     procs, logs = [], []
-    for pid in range(nproc):
+    for pid in wids:
         e = dict(base)
         if worker_env and pid in worker_env:
             e.update({k: str(v) for k, v in worker_env[pid].items()})
+        if pid in extra_workers:
+            e.update({k: str(v) for k, v in extra_workers[pid].items()})
         log = open(os.path.join(out_dir, "worker.%d.log" % pid), "wb")
         logs.append(log)
         procs.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
              str(pid)],
             env=e, stdout=log, stderr=subprocess.STDOUT))
-    rcs = [None] * nproc
+    total = len(wids)
+    rcs = [None] * total
     deadline = time.time() + timeout
     released = False
     try:
@@ -123,7 +158,7 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
                 if all(rcs[pid] is not None
                        or os.path.exists(os.path.join(
                            out_dir, "result.%d.json" % pid))
-                       for pid in range(nproc) if pid not in tolerate):
+                       for pid in range(total) if pid not in tolerate):
                     rel = os.path.join(out_dir, "release")
                     with open(rel + ".tmp", "w") as f:
                         f.write("1")
@@ -140,6 +175,7 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
                 while time.time() < grace and any(
                         p.poll() is None for p in procs):
                     time.sleep(0.05)
+                _dump_stacks(procs)
                 for p in procs:
                     if p.poll() is None:
                         p.kill()
@@ -158,6 +194,7 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
                                         "worker.%d.log" % dead)))
                 break
             if time.time() > deadline:
+                _dump_stacks(procs)
                 for p in procs:
                     if p.poll() is None:
                         p.kill()
@@ -175,7 +212,7 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
         for log in logs:
             log.close()
     results = []
-    for pid in range(nproc):
+    for pid in range(total):
         path = os.path.join(out_dir, "result.%d.json" % pid)
         if os.path.exists(path):
             with open(path) as f:
@@ -203,7 +240,10 @@ def run_cluster(payload, nproc=2, devs=1, timeout=300, env=None,
 def _bootstrap(pid):
     """Per-worker preamble: force the virtual CPU topology BEFORE any
     backend query, then join the cluster through the blessed
-    multihost.initialize door (which arms gloo on CPU)."""
+    multihost.initialize door (which arms gloo on CPU).  A REJOINER
+    (``BOLT_MH_REJOINER=1`` — the replacement process of the elastic
+    3→2→3 scenario) skips the initialize: it joins LATER through
+    ``supervisor.attach`` once the incumbents publish a plan."""
     devs = int(os.environ["BOLT_MH_DEVS"])
     nproc = int(os.environ["BOLT_MH_NPROC"])
     os.environ["XLA_FLAGS"] = (
@@ -212,7 +252,7 @@ def _bootstrap(pid):
     os.environ["JAX_PLATFORMS"] = "cpu"
     sys.path.insert(0, _REPO)
     from bolt_tpu.parallel import multihost
-    if nproc > 1:
+    if nproc > 1 and os.environ.get("BOLT_MH_REJOINER") != "1":
         ok = multihost.initialize(
             coordinator_address="127.0.0.1:%s" % os.environ["BOLT_MH_PORT"],
             num_processes=nproc, process_id=pid)
@@ -232,15 +272,18 @@ def _mesh():
     return Mesh(np.asarray(jax.devices()), ("k",))
 
 
-def _crafted(n, vdim):
-    """Bit-exactness-crafted data: period-8 integer pattern (+ a half-
-    step per value slot).  Sums are exact in f32, every shard of a
-    multiple-of-8 record range has the SAME mean, so the hierarchical
-    (per-shard + collective) moments equal the single-process moments
-    BIT for bit — the same trick the crafted-Welford stream suite
-    uses."""
+def _crafted(n, vdim, period=8):
+    """Bit-exactness-crafted data: period-``period`` integer pattern
+    (+ a half-step per value slot).  Sums are exact in f32, every
+    shard of a multiple-of-``period`` record range has the SAME mean,
+    so the hierarchical (per-shard + collective) moments equal the
+    single-process moments BIT for bit — the same trick the
+    crafted-Welford stream suite uses.  ``period=4`` keeps the moments
+    exact on shard lengths divisible by 4 (a 96-record key axis split
+    3 ways into 8-record slab shards AND 2 ways into 12-record ones —
+    the elastic 3→2→3 scenario's geometry)."""
     import numpy as np
-    r = np.arange(n, dtype=np.float32) % 8
+    r = np.arange(n, dtype=np.float32) % period
     v = np.arange(vdim, dtype=np.float32) * 0.5
     return (r[:, None] + v[None, :]).astype(np.float32)
 
@@ -676,6 +719,229 @@ def payload_serve_pod(pid):
     return res
 
 
+def payload_supervise(pid):
+    """The ISSUE-12 acceptance payload: SELF-HEALING end to end.
+
+    Every process runs ``Server(supervise=True)`` and submits three
+    pipelines in SPMD order:
+
+    * **A** (checkpointed paced sum): the victim is SIGKILLed mid-A —
+      survivors' futures succeed with ZERO caller intervention (the
+      held ``retries=`` re-attempt resumes once the supervisor's
+      automatic 3→2 reform lands);
+    * **B** (checkpointed paced sum): a REPLACEMENT process
+      (``BOLT_MH_REJOINER=1``, skipped ``multihost.initialize``) rings
+      the rejoin door MID-B — incumbents quiesce at a slab-boundary
+      checkpoint, the supervisor reforms 2→3, and B's re-attempt
+      resumes on the re-expanded pod (the rejoiner submits B too and
+      joins the same resumed slab schedule);
+    * **C** (fused ``stats("sum","var")``, period-4 crafted data): a
+      clean run on the re-expanded 3-wide pod.
+
+    Run without chaos/rejoiner (the reference leg) all three stream
+    clean 3-wide; sums are integer-exact under any process grouping
+    and C's shards are period-aligned at both widths, so every saved
+    artifact must be BIT-IDENTICAL between the legs."""
+    import glob as _glob
+    import time as _time
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import engine, obs, serve
+    from bolt_tpu import checkpoint as ckptlib
+    from bolt_tpu.parallel import multihost, podwatch, supervisor
+    from bolt_tpu.obs.trace import clock
+
+    out = os.environ["BOLT_MH_OUT"]
+    ckroot = os.environ["BOLT_MH_CKPT"]
+    hbdir = os.environ["BOLT_POD_HB_DIR"]
+    pace = float(os.environ.get("BOLT_MH_PACE", "0.2"))
+    rejoiner = os.environ.get("BOLT_MH_REJOINER") == "1"
+    n, chunks, vdim = 96, 12, 8           # 8 slabs; 12 % 3 == 12 % 2 == 0
+    x = _crafted(n, vdim)                 # integer-exact sums
+    x2 = _crafted(n, vdim, period=4)      # moment-exact at widths 2 AND 3
+    obs.clear()
+    obs.enable()
+    res = {"pid": pid, "rejoiner": rejoiner}
+    deaths = []
+    podwatch.on_peer_death(
+        lambda dead: deaths.append(
+            (dead, podwatch.peers().get(dead, {}).get("age"), clock())))
+
+    def loader(idx):
+        if pace:
+            _time.sleep(pace)
+        return x[idx]
+
+    # jobs are FACTORIES, not arrays: a retry after a reform must
+    # rebuild the pipeline against the CURRENT (reformed) mesh — the
+    # checkpoint fingerprint ignores topology, so the re-attempt
+    # resumes the same logical run on the new pod width
+    def make_sum(name):
+        def job():
+            src = bolt.fromcallback(
+                loader, (n, vdim), _mesh(), dtype=np.float32,
+                chunks=chunks, checkpoint=os.path.join(ckroot, name),
+                per_process=True)
+            return src.map(ADD1).sum().cache()
+        return job
+
+    def make_stats():
+        def job():
+            src = bolt.fromcallback(
+                lambda idx: x2[idx], (n, vdim), _mesh(),
+                dtype=np.float32, chunks=24,
+                checkpoint=os.path.join(ckroot, "statsC"),
+                per_process=True)
+            return src.map(ADD1).stats("sum", "var")
+        return job
+
+    if rejoiner:
+        # wait until every incumbent survivor has B in flight, then
+        # ring the doorbell and join through the published plan
+        want = int(os.environ.get("BOLT_MH_EXPECT_BSTART", "2"))
+        hold = _time.monotonic() + 180
+        while len(_glob.glob(os.path.join(out, "b_started.*"))) < want:
+            if _time.monotonic() > hold:
+                raise RuntimeError("rejoiner: b_started gate never "
+                                   "opened")
+            _time.sleep(0.02)
+        t0 = clock()
+        sup = supervisor.attach(
+            os.environ.get("BOLT_MH_REJOIN_ID", "w%db" % pid), dir=hbdir)
+        res["attach_s"] = clock() - t0
+        res["new_pid"] = multihost.process_index()
+        res["new_nproc"] = multihost.process_count()
+        sv = serve.start(workers=1, budget_bytes=64 << 20,
+                         supervise=sup)
+    else:
+        sv = serve.start(workers=1, budget_bytes=64 << 20,
+                         supervise=True)
+
+    ec0 = engine.counters()
+    try:
+        if not rejoiner:
+            # ---- A: kill -9 mid-stream -> automatic shrink ----------
+            tA = clock()
+            futA = sv.submit(make_sum("sumA"), tenant="elastic",
+                             retries=3)
+            sA = futA.result(timeout=300)
+            res["wall_a"] = clock() - tA
+            np.save(os.path.join(out, "sup_sumA.%d.npy" % pid),
+                    _value(sA))
+            ecA = engine.counters()
+            res["a_resumes"] = ecA["stream_resumes"] \
+                - ec0["stream_resumes"]
+            stA = sv.stats()
+            res["a_reforms"] = stA["totals"]["reforms"]
+            res["a_peer_losses"] = stA["totals"]["peer_losses"]
+            res["budget_share_after_a"] = stA["pod"]["budget_share"]
+            res["detection_age"] = deaths[0][1] if deaths else None
+            supA = (sv.supervisor.stats() if sv.supervisor is not None
+                    else {})
+            res["reform_s"] = supA.get("last_reform_seconds")
+            res["recovery_s"] = supA.get("last_recovery_seconds")
+
+            # ---- B: rejoin arrives mid-stream -> quiesce + grow -----
+            tB = clock()
+            futB = sv.submit(make_sum("sumB"), tenant="elastic",
+                             retries=3)
+            gate = os.path.join(out, "b_started.%d" % pid)
+            with open(gate + ".tmp", "w") as f:
+                f.write("1")
+            os.replace(gate + ".tmp", gate)
+        else:
+            tB = clock()
+            futB = sv.submit(make_sum("sumB"), tenant="elastic",
+                             retries=3)
+        ecB0 = engine.counters()
+        sB = futB.result(timeout=300)
+        res["wall_b"] = clock() - tB
+        np.save(os.path.join(out, "sup_sumB.%d.npy" % pid), _value(sB))
+        ecB = engine.counters()
+        res["b_resumes"] = ecB["stream_resumes"] - ecB0["stream_resumes"]
+        stB = sv.stats()
+        res["reforms"] = stB["totals"]["reforms"]
+        res["rejoins"] = stB["totals"]["rejoins"]
+        res["supervise_seconds"] = stB["totals"]["supervise_seconds"]
+        res["budget_share_after_b"] = stB["pod"]["budget_share"]
+        res["nproc_after_b"] = multihost.process_count()
+        if sv.supervisor is not None:
+            sup_st = sv.supervisor.stats()
+            res["rejoin_recovery_s"] = sup_st.get(
+                "last_recovery_seconds")
+
+        # ---- C: clean fused stats on the re-expanded pod ------------
+        tC = clock()
+        futC = sv.submit(make_stats(), tenant="elastic", retries=3)
+        stats = futC.result(timeout=300)
+        res["wall_c"] = clock() - tC
+        np.save(os.path.join(out, "sup_statsC_sum.%d.npy" % pid),
+                _value(stats["sum"]))
+        np.save(os.path.join(out, "sup_statsC_var.%d.npy" % pid),
+                _value(stats["var"]))
+        res["arbiter_bytes_after"] = \
+            sv.stats()["arbiter"]["in_use_bytes"]
+
+        # ---- checker integration on the live re-expanded pod --------
+        from bolt_tpu import analysis
+        blocks = [x[i:i + chunks] for i in range(0, n, chunks)]
+        fi = bolt.fromiter(blocks, (n, vdim), _mesh(),
+                           dtype=np.float32)
+        res["blt014"] = analysis.check(fi.map(ADD1)).has("BLT014")
+        probe = bolt.fromcallback(lambda idx: x[idx], (n, vdim),
+                                  _mesh(), dtype=np.float32,
+                                  chunks=chunks, per_process=True)
+        res["explain_supervised"] = \
+            "SUPERVISED" in analysis.explain(probe.map(ADD1))
+    finally:
+        serve.stop(wait=True)
+    # hygiene observables: no stale ckpt, no leaked spans, no stale
+    # transport markers beyond the one-epoch grace the sweep keeps
+    res["stale_ckpt"] = [name for name in ("sumA", "sumB", "statsC")
+                         if ckptlib.stream_pending(
+                             os.path.join(ckroot, name))]
+    tr = podwatch.transport()
+    res["stale_markers"] = (tr.stale_marker_count()
+                            if tr is not None else 0)
+    res["final_epoch"] = podwatch.epoch()
+    res["leaked_spans"] = obs.active_count()
+    obs.disable()
+    return res
+
+
+def payload_precollective(pid):
+    """The pre-collective death bound (ISSUE 12): the victim dies at
+    its FIRST upload — before any collective was ever dispatched — and
+    the survivor's readiness rendezvous must convert that into a
+    pointed ``PeerLostError`` within ~2x ``BOLT_POD_TIMEOUT``, not
+    gloo's ~30s connect timeout."""
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu.parallel import multihost, podwatch
+    from bolt_tpu.obs.trace import clock
+
+    n, vdim, chunks = 64, 8, 8
+    x = _crafted(n, vdim)
+
+    def make():
+        src = bolt.fromcallback(lambda idx: x[idx], (n, vdim), _mesh(),
+                                dtype=np.float32, chunks=chunks,
+                                per_process=True)
+        return src.map(ADD1).sum()
+
+    res = {"pid": pid, "deadline": podwatch.deadline()}
+    t0 = clock()
+    try:
+        make().cache()
+        res["pre_peerlost"] = False
+    except multihost.PeerLostError as exc:
+        res["pre_peerlost"] = True
+        res["pre_elapsed"] = clock() - t0
+        res["pre_phase"] = exc.phase
+        res["pre_peer"] = exc.peer
+    return res
+
+
 PAYLOADS = {
     "stream_parity": payload_stream_parity,
     "single_ref": payload_single_ref,
@@ -683,10 +949,15 @@ PAYLOADS = {
     "bench": payload_bench,
     "reform": payload_reform,
     "serve_pod": payload_serve_pod,
+    "supervise": payload_supervise,
+    "precollective": payload_precollective,
 }
 
 
 def worker_main(pid):
+    import faulthandler
+    import signal
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     _bootstrap(pid)
     payload = PAYLOADS[os.environ["BOLT_MH_PAYLOAD"]]
     res = payload(pid)
@@ -717,6 +988,145 @@ def worker_main(pid):
         # result is durably on disk, so leave without ceremony
         sys.stdout.flush()
         os._exit(0)
+
+
+# ---------------------------------------------------------------------
+# the elastic bench (bench_all config 13 / perf_regress
+# multihost_elastic): the 3→2→3 self-healing scenario + the
+# pre-collective death bound
+# ---------------------------------------------------------------------
+
+def run_supervise_bench(nproc=3, pace=0.2, kill_at=4, pod_timeout=2.0,
+                        timeout=420, workdir=None):
+    """The ISSUE-12 acceptance scenario, packaged for the bench
+    harness: a CLEAN ``nproc``-process reference run of the supervised
+    workload (pipelines A, B, C — see ``payload_supervise``), then the
+    ELASTIC leg — worker 1 SIGKILLed mid-A (automatic 3→2 shrink with
+    zero caller intervention), a replacement process rejoining mid-B
+    (quiesce + 2→3 re-expansion), C clean on the re-expanded pod.
+    Every artifact must be bit-identical between legs; the gate is
+    scenario-vs-clean wall < 2.5x plus zero leaked arbiter bytes /
+    spans / stale transport markers / stale checkpoints."""
+    import shutil
+    import numpy as np
+    own = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="bolt-mh-elastic-")
+    env = {"BOLT_MH_PACE": pace, "BOLT_POD_TIMEOUT": pod_timeout,
+           "BOLT_CHECKPOINT_EVERY": "1", "BOLT_MH_HARD_EXIT": "1",
+           "BOLT_SUPERVISE_BACKOFF": "0.25"}
+    try:
+        out_c = os.path.join(workdir, "out-clean")
+        out_e = os.path.join(workdir, "out-elastic")
+        os.makedirs(out_c, exist_ok=True)
+        os.makedirs(out_e, exist_ok=True)
+        # -- the clean 3-wide reference -------------------------------
+        res_c, out_c, _ = run_cluster(
+            "supervise", nproc=nproc, devs=1, timeout=timeout,
+            out_dir=out_c,
+            env=dict(env, BOLT_MH_CKPT=os.path.join(workdir, "ck-clean"),
+                     BOLT_POD_HB_DIR=os.path.join(workdir, "hb-clean")))
+        clean_s = max(r["wall_a"] + r["wall_b"] + r["wall_c"]
+                      for r in res_c)
+        refs = {name: np.load(os.path.join(out_c, "%s.0.npy" % name))
+                for name in ("sup_sumA", "sup_sumB", "sup_statsC_sum",
+                             "sup_statsC_var")}
+        # -- the elastic leg: kill mid-A, rejoin mid-B ----------------
+        res, out, rcs = run_cluster(
+            "supervise", nproc=nproc, devs=1, timeout=timeout,
+            tolerate={1}, out_dir=out_e,
+            env=dict(env, BOLT_MH_CKPT=os.path.join(workdir, "ck-el"),
+                     BOLT_POD_HB_DIR=os.path.join(workdir, "hb-el"),
+                     BOLT_MH_EXPECT_BSTART=str(nproc - 1)),
+            worker_env={1: {"BOLT_CHAOS":
+                            "stream.upload:%d:kill" % kill_at}},
+            extra_workers={nproc: {"BOLT_MH_REJOINER": "1",
+                                   "BOLT_MH_REJOIN_ID": "w1b"}})
+        done = [r for r in res if r is not None]
+        survivors = [r for r in done if not r["rejoiner"]]
+        rejoiner = [r for r in done if r["rejoiner"]]
+        bit = all(
+            np.array_equal(np.load(os.path.join(
+                out, "%s.%d.npy" % (name, r["pid"]))), refs[name])
+            for r in done
+            for name in refs
+            if not (r["rejoiner"] and name == "sup_sumA"))
+        scenario_s = max(r["wall_a"] + r["wall_b"] + r["wall_c"]
+                         for r in survivors)
+        return {
+            "clean_s": clean_s,
+            "scenario_s": scenario_s,
+            "scenario_over_clean": scenario_s / clean_s,
+            "detection_s": max(r.get("detection_age") or 0.0
+                               for r in survivors),
+            "reform_s": max(r.get("reform_s") or 0.0
+                            for r in survivors),
+            "recovery_s": max(r.get("recovery_s") or 0.0
+                              for r in survivors),
+            "rejoin_s": max(r.get("rejoin_recovery_s") or 0.0
+                            for r in survivors),
+            "attach_s": (rejoiner[0].get("attach_s")
+                         if rejoiner else None),
+            "pod_timeout": float(pod_timeout),
+            "victim_rc": rcs[1],
+            "survivors": len(survivors),
+            "rejoined": len(rejoiner),
+            "a_resumes": sum(r.get("a_resumes", 0) for r in survivors),
+            "b_resumes": sum(r.get("b_resumes", 0) for r in survivors),
+            "reforms": max(r.get("reforms", 0) for r in done),
+            "rejoins": max(r.get("rejoins", 0) for r in done),
+            "nproc_final": max(r.get("nproc_after_b", 0) for r in done),
+            "budget_share_after_a": min(
+                r.get("budget_share_after_a", 1.0) for r in survivors),
+            "budget_share_after_b": max(
+                r.get("budget_share_after_b", 0.0) for r in done),
+            "bit_identical": bool(bit),
+            "arbiter_bytes": max(r.get("arbiter_bytes_after", 0)
+                                 for r in done),
+            "stale_ckpt": sorted({c for r in done
+                                  for c in r.get("stale_ckpt", [])}),
+            "stale_markers": max(r.get("stale_markers", 0)
+                                 for r in done),
+            "leaked_spans": sum(r.get("leaked_spans", 0) for r in done),
+            "blt014": all(r.get("blt014") for r in done),
+            "explain_supervised": all(r.get("explain_supervised")
+                                      for r in done),
+        }
+    except BaseException:
+        own = False      # keep worker logs for post-mortem
+        raise
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_precollective_probe(pod_timeout=2.0, timeout=180, workdir=None):
+    """The closed pre-collective bound, measured: worker 1 dies at its
+    FIRST upload (no collective ever dispatched); the survivor must
+    catch ``PeerLostError`` within 2x ``pod_timeout`` — not gloo's
+    ~30s connect timeout."""
+    import shutil
+    own = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="bolt-mh-precoll-")
+    try:
+        res, out, rcs = run_cluster(
+            "precollective", nproc=2, devs=1, timeout=timeout,
+            tolerate={1}, out_dir=os.path.join(workdir, "out"),
+            env={"BOLT_POD_TIMEOUT": pod_timeout,
+                 "BOLT_MH_HARD_EXIT": "1",
+                 "BOLT_POD_HB_DIR": os.path.join(workdir, "hb")},
+            worker_env={1: {"BOLT_CHAOS": "stream.upload:1:kill"}})
+        r = res[0]
+        return {"victim_rc": rcs[1],
+                "pre_peerlost": r.get("pre_peerlost"),
+                "pre_elapsed": r.get("pre_elapsed"),
+                "pre_phase": r.get("pre_phase"),
+                "pod_timeout": float(pod_timeout)}
+    except BaseException:
+        own = False      # keep worker logs for post-mortem
+        raise
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------
@@ -821,6 +1231,9 @@ def run_reform_bench(nproc=3, nkeys=96, chunks=12, vdim=8, pace=0.25,
             "leaked_spans": sum(r.get("leaked_spans", 0)
                                 for r in survivors),
         }
+    except BaseException:
+        own = False      # keep worker logs for post-mortem
+        raise
     finally:
         if own:
             shutil.rmtree(workdir, ignore_errors=True)

@@ -304,6 +304,90 @@ def test_reform_recovery_bounded_and_clean(reform):
     assert reform["leaked_spans"] == 0
 
 
+# ---------------------------------------------------------------------
+# ISSUE 12: self-healing pods — kill -9 under Server(supervise=True)
+# -> automatic 3->2 shrink, a restarted replacement rejoins -> 2->3
+# re-expansion, ZERO caller intervention, on a REAL localhost cluster
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def elastic():
+    """ONE 3→2→3 supervised scenario (kill -9 mid-stream, replacement
+    rejoin mid-stream, plus its clean 3-process reference) serves every
+    self-healing assertion below — see scripts/multihost_harness.py
+    run_supervise_bench/payload_supervise."""
+    if not _HAS_GLOO:
+        pytest.skip("no CPU cross-process collective transport")
+    mh = _harness()
+    return mh.run_supervise_bench()
+
+
+@needs_cluster
+def test_supervised_shrink_is_automatic(elastic):
+    """kill -9 of one worker under Server(supervise=True): survivors'
+    futures SUCCEED with zero caller intervention — the held retry
+    resumes once the supervisor's automatic 3→2 reform lands — and
+    detection stays within 2x BOLT_POD_TIMEOUT."""
+    assert elastic["victim_rc"] == -9
+    assert elastic["survivors"] == 2
+    assert elastic["detection_s"] <= 2 * elastic["pod_timeout"], elastic
+    assert elastic["a_resumes"] >= 2          # one per survivor
+    assert elastic["reforms"] >= 1
+    # degraded-capacity admission: the arbiter budget rescaled to the
+    # surviving share after the shrink
+    assert abs(elastic["budget_share_after_a"] - 2 / 3) < 1e-6
+
+
+@needs_cluster
+def test_rejoin_re_expands_the_pod(elastic):
+    """A restarted replacement process rings the rejoin door MID-B:
+    incumbents quiesce at a slab-boundary checkpoint, reform 2→3, and
+    B resumes on the re-expanded pod — full capacity restored."""
+    assert elastic["rejoined"] == 1
+    assert elastic["rejoins"] >= 1
+    assert elastic["nproc_final"] == 3
+    assert elastic["b_resumes"] >= 2
+    assert elastic["budget_share_after_b"] == 1.0
+
+
+@needs_cluster
+def test_elastic_bit_identical_and_bounded(elastic):
+    """Every artifact of the 3→2→3 scenario — streamed sums A and B,
+    fused stats("sum","var") C — is BIT-IDENTICAL to the unkilled
+    3-process run, the whole scenario stays under 2.5x the clean wall,
+    and nothing leaks: arbiter bytes, spans, stale checkpoints, stale
+    transport markers."""
+    assert elastic["bit_identical"]
+    assert elastic["scenario_over_clean"] < 2.5, elastic
+    assert elastic["arbiter_bytes"] == 0
+    assert elastic["leaked_spans"] == 0
+    assert elastic["stale_ckpt"] == []
+    assert elastic["stale_markers"] == 0
+
+
+@needs_cluster
+def test_blt014_and_explain_on_the_live_pod(elastic):
+    """On the re-expanded pod the checker flags a fromiter source as
+    BLT014 (a rejoined process could never re-ingest its shard) and
+    explain() renders the SUPERVISED recovery plan."""
+    assert elastic["blt014"]
+    assert elastic["explain_supervised"]
+
+
+@needs_cluster
+def test_pre_collective_death_bounded():
+    """A peer killed BEFORE the first collective: the survivor's
+    readiness rendezvous raises the pointed PeerLostError within 2x
+    BOLT_POD_TIMEOUT — not gloo's ~30s connect timeout (the documented
+    PR 11 bound, now closed)."""
+    mh = _harness()
+    r = mh.run_precollective_probe()
+    assert r["victim_rc"] == -9
+    assert r["pre_peerlost"] is True, r
+    assert r["pre_elapsed"] <= 2 * r["pod_timeout"], r
+    assert "ready" in (r["pre_phase"] or "")
+
+
 @needs_cluster
 def test_serve_pod_degrades_instead_of_deadlocking():
     """A serving tenant's in-flight future FAILS with PeerLostError

@@ -281,6 +281,37 @@ def test_reraise_classifies_transport_errors(watchdir):
         podwatch.reraise(boom, wait=False)
 
 
+def test_reraise_classifies_secondary_deleted_array(watchdir):
+    """A failed async collective invalidates its output buffers; the
+    NEXT dispatch consuming them raises "Array has been deleted" — the
+    one-step-removed shape of a dead peer.  It converts to
+    PeerLostError only when the heartbeat actually latched someone."""
+    _start(watchdir, timeout=0.2)
+    deleted = RuntimeError("Array has been deleted with shape=float32[8].")
+    assert podwatch.is_secondary_sign(deleted)
+    assert not podwatch.is_transport_error(deleted)
+    # nobody dead: the genuine deleted-array bug surfaces untouched
+    with pytest.raises(RuntimeError, match="has been deleted"):
+        podwatch.reraise(deleted, wait=False)
+    # a latched dead peer: classified, chained, named
+    podwatch.mark_dead(1)
+    with pytest.raises(PeerLostError) as ei:
+        podwatch.reraise(deleted, phase="slab program", slab=3)
+    assert ei.value.peer == 1
+    assert ei.value.__cause__ is deleted
+    # the grace window: the peer latches dead WHILE reraise waits
+    podwatch._WATCH.dead.clear()
+    import threading
+    t = threading.Timer(0.1, podwatch.mark_dead, args=(1,))
+    t.start()
+    try:
+        with pytest.raises(PeerLostError) as ei:
+            podwatch.reraise(deleted, phase="slab program", slab=4)
+        assert ei.value.peer == 1
+    finally:
+        t.cancel()
+
+
 def test_guard_contextmanager(watchdir):
     _start(watchdir, timeout=0.2)
     with podwatch.guard("unit"):
