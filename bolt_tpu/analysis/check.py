@@ -243,6 +243,51 @@ def working_set_bytes(obj):
     return int(out_bytes)
 
 
+def _batching_policy():
+    """The ACTIVE server's batching policy, or ``None`` when no
+    batching-enabled server is running (consulted via ``sys.modules``
+    like the BLT010 budget — checking never imports the serving
+    layer)."""
+    sv = sys.modules.get("bolt_tpu.serve")
+    if sv is None:
+        return None
+    srv = sv.active()
+    return getattr(srv, "batching", None) if srv is not None else None
+
+
+def _note_batchable(arr, idx, diags):
+    """``BLT015``: forecast serve micro-batching — a batching-enabled
+    server is active and this pipeline carries a batch key
+    (``bolt_tpu.tpu.batched.batch_key``), so queued same-key requests
+    (same structure, shapes, dtypes, terminal and sharding — across
+    tenants) will coalesce into ONE stacked dispatch at bucketed
+    widths, bit-identical to the standalone dispatch."""
+    pol = _batching_policy()
+    if pol is None:
+        return
+    bt = sys.modules.get("bolt_tpu.tpu.batched")
+    if bt is None:
+        return
+    try:
+        key = bt.batch_key(arr)
+    except Exception:
+        return
+    if key is None:
+        return
+    diags.append(Diagnostic(
+        "BLT015", idx,
+        "terminal is batch-eligible (%s form): the active batching "
+        "server coalesces up to %d queued same-key requests — same "
+        "pipeline structure/shape/dtype/terminal/sharding, across "
+        "tenants — into ONE stacked dispatch at bucketed widths %s, "
+        "each lane bit-identical to its standalone dispatch"
+        % (key[0], pol.max_batch, tuple(pol.buckets)),
+        hint="submit same-shape pipelines concurrently to share one "
+             "batched executable; serve.stats()['batching'] shows the "
+             "realised occupancy, batched.warm() pre-compiles the "
+             "buckets"))
+
+
 def _note_fusable(arr, idx, diags):
     """``BLT009``: forecast the single-pass fusion — this array's
     source carries a live fused stat group (bolt_tpu/tpu/multistat.py),
@@ -283,6 +328,7 @@ def _check_spending(arr, target, stages, diags):
         note="terminal of a %d-member fused group, not yet dispatched"
              % len(g.members)))
     _note_fusable_group(g, 1, diags)
+    _note_batchable(arr, 1, diags)
     _note_admission(_stream_slab_bytes(g.source) if g.kind == "stream"
                     else _group_bytes(g), 1, diags)
     return Report(target + ", pending stat", stages, diags)
@@ -572,6 +618,7 @@ def _check_impl(obj):
                  "engine.donation(None) to keep it readable"))
 
     _note_fusable(arr, len(stages) - 1, diags)
+    _note_batchable(arr, len(stages) - 1, diags)
     rep = Report(target, stages, diags, dynamic=dynamic)
     engine.record_diagnostics(len(diags))
     return rep

@@ -41,6 +41,9 @@ CODES = {
     "BLT014": ("warning",
                "supervised pod stream's source cannot serve a rejoined "
                "process: re-expansion impossible for this run"),
+    "BLT015": ("info",
+               "terminal is batch-eligible: a batching server coalesces "
+               "same-key requests into one dispatch"),
 }
 
 SEVERITIES = ("error", "warning", "info")

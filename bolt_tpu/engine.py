@@ -151,6 +151,14 @@ _SCHEMA = {
                                   # build of the same key
     "coalesced_compiles": 0,      # dispatches that joined an in-flight
                                   # lower+compile of the same signature
+    # continuous micro-batching (bolt_tpu.serve Server(batching=...) +
+    # bolt_tpu/tpu/batched.py): queued same-key small requests coalesced
+    # into ONE stacked/vmapped dispatch at bucketed widths.
+    # requests - dispatches = dispatches saved; the occupancy
+    # distribution lives in the registry histogram
+    # "serve.batch_occupancy.hist"
+    "batched_dispatches": 0,      # coalesced batched program dispatches
+    "batched_requests": 0,        # requests served BY those dispatches
 }
 
 _COUNTERS = _metrics.registry().group("engine", _SCHEMA)
@@ -418,6 +426,15 @@ def donation(min_bytes):
         yield
     finally:
         st.pop()
+
+
+def record_batched(n_requests):
+    """Tally one coalesced serve dispatch (bolt_tpu/tpu/batched.py)
+    serving ``n_requests`` queued same-key requests from one stacked
+    program; the timeline carries it as the ``serve.batched_dispatch``
+    span."""
+    _COUNTERS.update(batched_dispatches=1,
+                     batched_requests=int(n_requests))
 
 
 def record_fused_stats(n_terminals):

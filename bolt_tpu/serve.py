@@ -48,7 +48,30 @@ that lets N tenants share one process and one device mesh safely:
   at submit time — the ``BLT010`` diagnostic
   (``bolt_tpu.analysis.check`` emits it whenever a serving arbiter is
   active, so ``explain()`` shows the refusal before anything is
-  queued).
+  queued);
+* **continuous micro-batching** (``Server(batching=...)``, ROADMAP
+  item 4): a high-QPS service is mostly a firehose of SMALL
+  identical-shape pipelines where per-request dispatch overhead — not
+  bytes — is the roofline.  Queued requests sharing a BATCH KEY (same
+  pipeline structure, shapes, dtypes, terminal and sharding — see
+  ``bolt_tpu.tpu.batched.batch_key``), ACROSS tenants, coalesce into
+  ONE stacked dispatch: inputs stack along a new leading axis, the
+  standalone terminal body runs vmapped (the ``StackedArray`` batched-
+  execution idea applied to the request queue), and each lane's
+  results scatter back to its request's ``Future`` — BIT-IDENTICAL to
+  the standalone dispatch.  Partial batches pad to bucketed widths
+  (powers of two up to ``max_batch``) so steady state compiles a small
+  fixed executable set and then runs zero fresh XLA compiles
+  (``bolt_tpu.tpu.batched.warm`` pre-compiles the buckets for a
+  fleet); a worker that found at least one coalescible partner lingers
+  up to ``linger`` seconds to fill the bucket, while a lone request
+  never waits.  Per-request attribution is preserved: every future
+  keeps its own wait/assembly/run seconds and ``batch_width``, every
+  tenant its own counters and arbiter leases.  Diagnostics:
+  ``BLT015`` forecasts batch eligibility, engine counters
+  ``batched_dispatches``/``batched_requests`` and the
+  ``serve.batch_occupancy.hist`` histogram record the realised
+  coalescing (``stats()["batching"]`` summarises them).
 
 Observability: queue depth (+ high-water), per-job queue-wait and run
 seconds (totals per tenant, a log2 histogram overall), arbiter
@@ -67,7 +90,9 @@ The blessed entry points::
 
 or the module-level :func:`submit`, which lazily starts a default
 server (env-tunable: ``BOLT_SERVE_WORKERS`` / ``BOLT_SERVE_BUDGET``
-/ ``BOLT_SERVE_QUEUE_LIMIT``).  Lint rule BLT108 keeps this module and
+/ ``BOLT_SERVE_QUEUE_LIMIT`` / ``BOLT_SERVE_BATCHING`` — with
+``BOLT_SERVE_MAX_BATCH`` and ``BOLT_SERVE_LINGER`` tuning the armed
+policy).  Lint rule BLT108 keeps this module and
 ``stream.py`` the ONLY homes of raw thread construction in the
 package — every other concurrency need routes through one of them.
 """
@@ -97,6 +122,11 @@ from bolt_tpu.parallel.podwatch import PeerLostError  # noqa: F401 — the
 _DEF_BUDGET = int(os.environ.get("BOLT_SERVE_BUDGET", str(1 << 30)))
 _DEF_WORKERS = max(1, int(os.environ.get("BOLT_SERVE_WORKERS", "4")))
 _DEF_QUEUE = max(1, int(os.environ.get("BOLT_SERVE_QUEUE_LIMIT", "64")))
+# continuous micro-batching default: OFF unless armed by env (the knob
+# is Server(batching=...); BOLT_SERVE_BATCHING=1 arms the default
+# server / bare Server() with the default policy)
+_DEF_BATCHING = os.environ.get("BOLT_SERVE_BATCHING", "").lower() \
+    in ("1", "true", "yes")
 
 # per-tenant + global serve counter schema (obs registry groups
 # "serve" and "serve/<tenant>")
@@ -127,6 +157,57 @@ class DeadlineError(RuntimeError):
     """A job's per-submit ``deadline=`` budget (seconds since submit)
     expired before it could start; delivered through
     ``Future.result()``."""
+
+
+class BatchPolicy:
+    """Continuous micro-batching policy (``Server(batching=...)``):
+
+    * ``max_batch`` — widest coalesced dispatch (one batched program
+      serves up to this many queued same-key requests; default
+      ``BOLT_SERVE_MAX_BATCH`` / 16);
+    * ``linger`` — micro-wait in seconds to FILL a forming batch: once
+      a worker's gather found at least one coalescible partner it waits
+      up to this long for more same-key arrivals before dispatching
+      (default ``BOLT_SERVE_LINGER`` / 0.002).  A lone request never
+      lingers, so low-QPS single-request latency is untouched;
+    * ``buckets`` — the compiled batch widths (default powers of two up
+      to ``max_batch``): partial batches PAD to the next bucket, so
+      steady state compiles a small fixed executable set and then runs
+      zero fresh XLA compiles.
+    """
+
+    __slots__ = ("max_batch", "linger", "buckets")
+
+    def __init__(self, max_batch=None, linger=None, buckets=None):
+        from bolt_tpu.tpu import batched as _batched
+        if buckets:
+            buckets = tuple(sorted(int(b) for b in buckets))
+            if buckets[0] < 2:
+                raise ValueError("batch buckets must be >= 2, got %r"
+                                 % (buckets,))
+            if max_batch is None:
+                max_batch = buckets[-1]
+        self.max_batch = int(max_batch if max_batch is not None
+                             else _batched.DEFAULT_MAX_BATCH)
+        if self.max_batch < 2:
+            raise ValueError("max_batch must be >= 2, got %d"
+                             % self.max_batch)
+        self.linger = float(linger if linger is not None
+                            else _batched.DEFAULT_LINGER)
+        if self.linger < 0:
+            raise ValueError("linger must be >= 0 seconds, got %r"
+                             % (linger,))
+        self.buckets = buckets or _batched.buckets_for(self.max_batch)
+        if self.buckets[-1] != self.max_batch:
+            raise ValueError(
+                "the largest bucket (%d) must EQUAL max_batch (%d): a "
+                "smaller one cannot serve a full batch, a larger one "
+                "would pad every dispatch past the promised widest "
+                "width" % (self.buckets[-1], self.max_batch))
+
+    def __repr__(self):
+        return ("BatchPolicy(max_batch=%d, linger=%g, buckets=%s)"
+                % (self.max_batch, self.linger, self.buckets))
 
 
 # ---------------------------------------------------------------------
@@ -353,7 +434,8 @@ class Future:
     execution time once known."""
 
     __slots__ = ("tenant", "_event", "_result", "_exc", "submitted_s",
-                 "started_s", "finished_s")
+                 "started_s", "finished_s", "batch_width",
+                 "assembly_seconds")
 
     def __init__(self, tenant):
         self.tenant = tenant
@@ -363,6 +445,13 @@ class Future:
         self.submitted_s = _clock()
         self.started_s = None
         self.finished_s = None
+        # micro-batching attribution (None when the job ran standalone):
+        # how many requests this job's coalesced dispatch actually
+        # served, and the assembly window — gather scan + linger
+        # micro-wait + claim, i.e. pop to dispatch begin (the device
+        # execution itself is run_seconds' job)
+        self.batch_width = None
+        self.assembly_seconds = None
 
     def done(self):
         return self._event.is_set()
@@ -436,6 +525,13 @@ def _estimate(arr):
     the ring; base + result for in-memory pipelines).  None when
     nothing could be estimated (callables, local arrays)."""
     try:
+        h = getattr(arr, "_spending", None)
+        if h is not None and h.group.kind == "chain":
+            # fast path for the high-QPS small-request shape: the
+            # admission floor of a chain-kind stat group is its one-pass
+            # read — exactly analysis.working_set_bytes' answer, without
+            # the per-submit import/isinstance walk
+            return int(h.group.base.nbytes)
         from bolt_tpu.analysis import admission_floor_bytes
         return admission_floor_bytes(arr)
     except Exception:
@@ -451,10 +547,37 @@ class Server:
 
     def __init__(self, workers=None, budget_bytes=None, queue_limit=None,
                  policy="queue", weights=None, start_warm=None,
-                 supervise=False):
+                 supervise=False, batching=None):
         if policy not in ("queue", "reject"):
             raise ValueError("policy must be 'queue' or 'reject', got %r"
                              % (policy,))
+        # continuous micro-batching (ROADMAP item 4): queued same-key
+        # requests — same pipeline structure, shapes, dtypes, terminal
+        # and sharding, ACROSS tenants — coalesce into ONE stacked
+        # dispatch (bolt_tpu/tpu/batched.py), results scattered back to
+        # their futures bit-identically.  batching=True arms the
+        # default BatchPolicy, a dict/BatchPolicy tunes max_batch /
+        # linger / buckets; None falls back to BOLT_SERVE_BATCHING;
+        # False is explicitly off.
+        if batching is None:
+            batching = _DEF_BATCHING
+        self.batching = None
+        self._batched = None
+        if batching:
+            if batching is True:
+                self.batching = BatchPolicy()
+            elif isinstance(batching, BatchPolicy):
+                self.batching = batching
+            elif isinstance(batching, dict):
+                self.batching = BatchPolicy(**batching)
+            else:
+                raise ValueError(
+                    "batching must be True/False, a dict of BatchPolicy "
+                    "kwargs, or a BatchPolicy (got %r)" % (batching,))
+            # arm() happens at the END of __init__: a constructor that
+            # raises past this point must not leak the armed count
+            # (nothing would ever disarm it, leaving the lazy-reduce
+            # door open with no batching server alive)
         self.workers = int(workers if workers is not None
                            else _DEF_WORKERS)
         self.queue_limit = int(queue_limit if queue_limit is not None
@@ -536,15 +659,28 @@ class Server:
                 self.supervisor.on_resume = self._sup_resume
         reg = _metrics.registry()
         self._counters = reg.group("serve", _SCHEMA)
+        self._tc_cache = {}            # tenant -> registry group (memo)
         self._g_depth = reg.gauge("serve.queue_depth")
         self._g_depth_hw = reg.gauge("serve.queue_depth_high_water")
         self._h_wait = reg.histogram("serve.queue_wait_seconds.hist")
+        # batch-occupancy distribution: one observation per coalesced
+        # dispatch, value = requests served (log2 buckets cover 1..256)
+        self._h_occ = reg.histogram("serve.batch_occupancy.hist",
+                                    lo=0, hi=9)
         self._threads = [
             threading.Thread(target=self._worker,
                              name="bolt-serve-worker-%d" % i, daemon=True)
             for i in range(self.workers)]
         for th in self._threads:
             th.start()
+        if self.batching is not None:
+            # truly LAST: nothing in __init__ can raise past this point,
+            # so the armed count can never leak without a server owning
+            # its disarm (workers started above consume nothing until
+            # the first submit)
+            from bolt_tpu.tpu import batched as _batched
+            self._batched = _batched
+            _batched.arm()            # opens multistat's lazy-reduce door
 
     # -- pod fault integration (bolt_tpu.parallel.podwatch) ------------
 
@@ -626,7 +762,14 @@ class Server:
     # -- submission ----------------------------------------------------
 
     def _tenant_counters(self, tenant):
-        return _metrics.registry().group("serve/%s" % tenant, _SCHEMA)
+        # memoised per server: the registry group lookup (string format
+        # + registry lock) measured as a real per-request cost on the
+        # high-QPS small-request path (3-4 lookups per job)
+        g = self._tc_cache.get(tenant)
+        if g is None:
+            g = self._tc_cache[tenant] = _metrics.registry().group(
+                "serve/%s" % tenant, _SCHEMA)
+        return g
 
     def _reject(self, tenant, why):
         self._counters.add("rejected")
@@ -705,6 +848,16 @@ class Server:
                 h = getattr(arr, "_spending", None)
                 if h is not None and h.group.kind == "stream":
                     streaming = True
+        # the batch key (continuous micro-batching): the coalescing
+        # identity of an in-memory lazy pipeline — None keeps the job
+        # on the standalone path (callables, streams, donating chains,
+        # batching off)
+        bkey = None
+        bt = self._batched            # close() clears it; a submit
+        if bt is not None and arr is not None and not streaming:
+            bkey = bt.batch_key(arr)  # racing a close must fall to the
+            #                           documented closed-server error,
+            #                           not an AttributeError
         admitted = False
         with self._cond:
             while self._depth >= self.queue_limit and not self._closing \
@@ -721,7 +874,8 @@ class Server:
                 # executor; in-memory pipelines lease their estimated
                 # working set around the dispatch
                 q.append((fut, job, None if streaming else est, retries,
-                          deadline))
+                          deadline, bkey,
+                          arr if bkey is not None else None))
                 self._depth += 1
                 self._g_depth.set(self._depth)
                 self._g_depth_hw.high_water(self._depth)
@@ -845,47 +999,271 @@ class Server:
             got = self._pop()
             if got is None:
                 return
-            tenant, (fut, job, est, nretry, deadline) = got
-            fut.started_s = _clock()
-            wait = fut.started_s - fut.submitted_s
-            self._counters.add("queue_wait_seconds", wait)
-            self._tenant_counters(tenant).add("queue_wait_seconds", wait)
-            self._h_wait.observe(wait)
-            sp = _obs.begin("serve.run", tenant=tenant,
-                            queued_s=round(wait, 6))
-            lease = self.arbiter.lease(tenant) if est else None
-            try:
-                with _engine.tenant(tenant):
-                    if deadline is not None and wait > deadline:
-                        # expired while queued: fail WITHOUT running —
-                        # the tenant's latency budget is already blown
-                        self._counters.add("expired")
-                        self._tenant_counters(tenant).add("expired")
-                        raise DeadlineError(
-                            "deadline %.3fs exceeded before the job "
-                            "started (queued %.3fs)" % (deadline, wait))
-                    # stop on CANCEL only: a close(wait=True) drain must
-                    # let queued leased jobs wait out the arbiter and run
-                    if lease is not None and not lease.acquire(
-                            est, stop=self._cancel):
-                        raise RuntimeError(
-                            "server cancelled before the job's working "
-                            "set (%d bytes) was granted" % est)
-                    out = self._run_attempts(job, fut, tenant, nretry,
-                                             deadline)
-                fut._finish(result=out)
-                key = "completed"
-            except BaseException as exc:    # noqa: BLE001 — delivered
-                fut._finish(exc=exc)        # through Future.result()
-                key = "failed"
-            finally:
-                if lease is not None:
-                    lease.close()           # leases are ALWAYS returned
-                _obs.end(sp)
-            run_s = fut.finished_s - fut.started_s
-            self._counters.update(**{key: 1, "run_seconds": run_s})
-            self._tenant_counters(tenant).update(
-                **{key: 1, "run_seconds": run_s})
+            tenant, item = got
+            extras = ()
+            t_gather = _clock()
+            if item[5] is not None and self.batching is not None:
+                extras = self._gather_batch(item[5], item[2])
+            if extras:
+                self._run_batch([(tenant, item)] + extras, t_gather)
+            else:
+                self._run_one(tenant, item)
+
+    def _run_one(self, tenant, item):
+        """Execute one job standalone (the pre-batching worker body)."""
+        fut, job, est, nretry, deadline = item[:5]
+        fut.started_s = _clock()
+        wait = fut.started_s - fut.submitted_s
+        self._counters.add("queue_wait_seconds", wait)
+        self._tenant_counters(tenant).add("queue_wait_seconds", wait)
+        self._h_wait.observe(wait)
+        sp = _obs.begin("serve.run", tenant=tenant,
+                        queued_s=round(wait, 6))
+        lease = self.arbiter.lease(tenant) if est else None
+        try:
+            with _engine.tenant(tenant):
+                if deadline is not None and wait > deadline:
+                    # expired while queued: fail WITHOUT running —
+                    # the tenant's latency budget is already blown
+                    self._counters.add("expired")
+                    self._tenant_counters(tenant).add("expired")
+                    raise DeadlineError(
+                        "deadline %.3fs exceeded before the job "
+                        "started (queued %.3fs)" % (deadline, wait))
+                # stop on CANCEL only: a close(wait=True) drain must
+                # let queued leased jobs wait out the arbiter and run
+                if lease is not None and not lease.acquire(
+                        est, stop=self._cancel):
+                    raise RuntimeError(
+                        "server cancelled before the job's working "
+                        "set (%d bytes) was granted" % est)
+                out = self._run_attempts(job, fut, tenant, nretry,
+                                         deadline)
+            fut._finish(result=out)
+            key = "completed"
+        except BaseException as exc:    # noqa: BLE001 — delivered
+            fut._finish(exc=exc)        # through Future.result()
+            key = "failed"
+        finally:
+            if lease is not None:
+                lease.close()           # leases are ALWAYS returned
+            _obs.end(sp)
+        run_s = fut.finished_s - fut.started_s
+        self._counters.update(**{key: 1, "run_seconds": run_s})
+        self._tenant_counters(tenant).update(
+            **{key: 1, "run_seconds": run_s})
+
+    # -- continuous micro-batching (bolt_tpu/tpu/batched.py) -----------
+
+    def _gather_batch(self, bkey, head_est):
+        """Pull every queued job sharing ``bkey`` — ACROSS tenants,
+        FIFO within each — up to the policy's ``max_batch``, lingering
+        up to ``linger`` seconds to fill the bucket once at least one
+        partner was found.  A gather that finds nothing returns
+        immediately (a lone request never waits).  Width is ALSO capped
+        by the arbiter budget: the coalesced dispatch's footprint is
+        the members' working sets PLUS the bucket-width stacked input
+        copy (~2x the sum), and assembling a batch the budget would
+        have serialised per-request must not bypass that arbitration.
+        Gathered jobs bypass the weighted-rotation credits: coalescing
+        is work-conserving — it only accelerates jobs that would
+        otherwise each pay their own dispatch, and the batch serves
+        multiple tenants at once."""
+        pol = self.batching
+        limit = pol.max_batch - 1       # the popped head is lane 0
+        est = int(head_est or 0)
+        if est:
+            # equal keys ⇒ equal geometry ⇒ equal per-request estimate:
+            # the coalesced lease is (W + bucket_width(W)) x est — the
+            # members plus the PADDED stacked copy — so pick the widest
+            # W the budget covers (a batch the budget would have
+            # serialised per-request must not assemble and then hit the
+            # arbiter's runs-alone escape)
+            from bolt_tpu.tpu.batched import bucket_width
+            w = 1
+            for cand in range(pol.max_batch, 1, -1):
+                if (cand + bucket_width(cand, pol.buckets)) * est \
+                        <= self.arbiter.budget:
+                    w = cand
+                    break
+            limit = min(limit, w - 1)
+        out = []
+        t0 = None
+        while limit > 0:
+            with self._cond:
+                for t in list(self._queues):
+                    if len(out) >= limit:
+                        break
+                    q = self._queues[t]
+                    keep = deque()
+                    # stop as soon as the batch fills: examined
+                    # non-matching jobs go back to the FRONT in order,
+                    # the unexamined tail is never touched — the scan
+                    # is O(taken + skipped), not O(queue depth)
+                    while q and len(out) < limit:
+                        it = q.popleft()
+                        if it[5] == bkey:
+                            out.append((t, it))
+                            self._depth -= 1
+                        else:
+                            keep.append(it)
+                    if keep:
+                        q.extendleft(reversed(keep))
+                    elif not q:
+                        del self._queues[t]
+                        self._ring.remove(t)
+                        self._credits.pop(t, None)
+                if out:
+                    self._g_depth.set(self._depth)
+                    self._cond.notify_all()   # free blocked submitters
+                full = len(out) >= limit
+                stopping = (self._closing or self._stop.is_set()
+                            or self._cancel.is_set())
+            if full or stopping or pol.linger <= 0 or not out:
+                return out
+            now = _clock()
+            if t0 is None:
+                t0 = now
+            rem = pol.linger - (now - t0)
+            if rem <= 0:
+                return out
+            with self._cond:
+                self._cond.wait(rem)    # a submit notifies the cond
+        return out                      # budget-capped width < 2: the
+        #                                 head runs standalone under its
+        #                                 own per-request arbitration
+
+    def _run_batch(self, items, t_gather):
+        """One coalesced dispatch serving ``len(items)`` same-key
+        requests: per-request wait/deadline/lease accounting first
+        (attribution preserved — every future keeps its own wait, run
+        and assembly seconds, every tenant its own counters), then ONE
+        claimed batched program (``batched.claim``/``dispatch``), then
+        per-request adoption through the normal retry machinery.  Any
+        claim/dispatch failure degrades every live request to its
+        standalone dispatch — batching is an optimisation, never a new
+        failure mode.  Note: the coalesced dispatch itself is
+        CROSS-TENANT and runs outside any ``engine.tenant`` scope — its
+        engine counters (dispatches, transfer bytes) land in the global
+        tally only; per-tenant SERVE counters are unaffected."""
+        width = len(items)
+        bsp = _obs.begin("serve.batch", width=width)
+        t_start = _clock()
+        live = []
+        lease = None
+        # per-request attribution is preserved, but the COUNTER totals
+        # apply once per (batch, tenant): every locked registry update
+        # measured as real per-request cost at small-request QPS, and
+        # totals aggregate identically
+        agg = {}
+
+        def _acc(tenant, **deltas):
+            d = agg.setdefault(tenant, {})
+            for k, v in deltas.items():
+                d[k] = d.get(k, 0 if isinstance(v, int) else 0.0) + v
+        try:
+            for t, it in items:
+                fut, _, est, _, dl = it[:5]
+                fut.started_s = t_start
+                wait = t_start - fut.submitted_s
+                _acc(t, queue_wait_seconds=wait)
+                self._h_wait.observe(wait)
+                if dl is not None and wait > dl:
+                    _acc(t, expired=1)
+                    self._finish_batched(t, fut, None, DeadlineError(
+                        "deadline %.3fs exceeded before the job "
+                        "started (queued %.3fs)" % (dl, wait)), _acc)
+                    continue
+                live.append((t, it))
+            # ONE summed lease covers the whole coalesced dispatch —
+            # the members' working sets PLUS the bucket-width stacked
+            # input copy the batched program materialises (pad lanes
+            # included); accounted under the head tenant — per-request
+            # arbiter round-trips measured as a real cost at
+            # small-request QPS
+            total_est = sum(it[2] or 0 for _, it in live)
+            if len(live) > 1 and total_est:
+                total_est += self._batched.bucket_width(
+                    len(live), self.batching.buckets) * max(
+                    it[2] or 0 for _, it in live)
+            if live and total_est:
+                lease = self.arbiter.lease(live[0][0])
+                if not lease.acquire(total_est, stop=self._cancel):
+                    lease.close()
+                    lease = None
+                    for t, it in live:
+                        self._finish_batched(t, it[0], None, RuntimeError(
+                            "server cancelled before the batch's "
+                            "working set (%d bytes) was granted"
+                            % total_est), _acc)
+                    live = []
+            batch = None
+            if len(live) > 1:
+                try:
+                    batch = self._batched.claim(
+                        [it[6] for _, it in live], live[0][1][5])
+                    if batch is not None:
+                        # assembly = pop -> dispatch begin: the gather
+                        # scan, the linger micro-wait and the claim —
+                        # the documented gather+linger+stack window,
+                        # NOT the device execution (run_seconds covers
+                        # that)
+                        asm = _clock() - t_gather
+                        self._batched.dispatch(batch,
+                                               self.batching.buckets)
+                        # realised coalescing only: a degraded gather
+                        # (failed claim/dispatch, expired members) must
+                        # not count as a coalesced dispatch, and only
+                        # requests the dispatch actually SERVED carry
+                        # the batch attribution (claim may drop raced
+                        # members — they dispatch standalone below and
+                        # keep the documented None)
+                        served = {id(a) for a in batch.arrs}
+                        self._h_occ.observe(len(served))
+                        for _, it in live:
+                            if id(it[6]) in served:
+                                it[0].batch_width = len(served)
+                                it[0].assembly_seconds = asm
+                except BaseException:   # noqa: BLE001 — degrade, the
+                    if batch is not None:   # per-request adoption below
+                        self._batched.unclaim(batch)   # re-dispatches
+                    #                                    standalone
+            # adoption (or standalone execution when the claim/dispatch
+            # degraded): the normal per-request retry/exception path
+            for t, it in live:
+                fut, job, _, nretry, dl = it[:5]
+                sp = _obs.begin("serve.run", tenant=t, batched=width)
+                try:
+                    try:
+                        with _engine.tenant(t):
+                            out = self._run_attempts(job, fut, t,
+                                                     nretry, dl)
+                        self._finish_batched(t, fut, out, None, _acc)
+                    except BaseException as exc:    # noqa: BLE001
+                        self._finish_batched(t, fut, None, exc, _acc)
+                finally:
+                    _obs.end(sp)
+        finally:
+            if lease is not None:
+                lease.close()           # leases are ALWAYS returned
+            for t, deltas in agg.items():
+                self._counters.update(**deltas)
+                self._tenant_counters(t).update(**deltas)
+            _obs.end(bsp)
+
+    def _finish_batched(self, tenant, fut, result, exc, acc):
+        """Deliver one batched request's outcome: identical future
+        delivery to the standalone path's, counters accumulated into
+        the batch's per-tenant aggregate instead of N locked registry
+        updates."""
+        if exc is None:
+            fut._finish(result=result)
+            key = "completed"
+        else:
+            fut._finish(exc=exc)
+            key = "failed"
+        acc(tenant, **{key: 1,
+                       "run_seconds": fut.finished_s - fut.started_s})
 
     # -- lifecycle / introspection -------------------------------------
 
@@ -895,10 +1273,21 @@ class Server:
 
     def stats(self):
         """One consistent-ish status dict: global serve counters, queue
-        depth, arbiter state, and a per-tenant breakdown (serve counters
-        + that tenant's scoped ENGINE counters — transfer bytes,
-        dispatches, compiles)."""
+        depth, arbiter state, a ``"batching"`` summary, and a
+        per-tenant breakdown (serve counters + LIVE queue depth + that
+        tenant's scoped ENGINE counters — transfer bytes, dispatches,
+        compiles).
+
+        Documented DEGRADED shapes (like ``profile.memory_stats``):
+        ``"batching"`` is ``{}`` — never an AttributeError — when the
+        server runs without a batching policy, and its ``"occupancy"``
+        sub-dict is ``{}`` until the first coalesced dispatch;
+        ``"tenants"`` is ``{}`` before any submit, and a tenant that
+        only ever queued (never ran) still appears with zeroed run
+        counters and its live ``queue_depth``."""
         reg = _metrics.registry()
+        with self._cond:
+            depths = {t: len(q) for t, q in self._queues.items()}
         out = {"queue_depth": self.queue_depth(),
                "queue_depth_high_water": self._g_depth_hw.value,
                "arbiter": {"budget_bytes": self.arbiter.budget,
@@ -917,6 +1306,7 @@ class Server:
                        "budget_share": (
                            self.arbiter.budget / self._budget0
                            if self._budget0 else 1.0)},
+               "batching": self._batching_stats(),
                "totals": self._counters.snapshot(),
                "tenants": {}}
         for name in reg.names():
@@ -927,8 +1317,41 @@ class Server:
                 entry["transfer_bytes"] = eng["transfer_bytes"]
                 entry["dispatches"] = eng["dispatches"]
                 entry["aot_compiles"] = eng["aot_compiles"]
+                entry["queue_depth"] = depths.pop(t, 0)
                 out["tenants"][t] = entry
+        for t, d in depths.items():
+            # queued-but-never-counted tenants (a submit can sit queued
+            # before its counter group exists under races): still show
+            # their live depth
+            out["tenants"].setdefault(t, {})["queue_depth"] = d
         return out
+
+    def _batching_stats(self):
+        """The ``stats()["batching"]`` block: ``{}`` when batching is
+        off; else the policy knobs plus the realised coalescing — the
+        engine's ``batched_dispatches``/``batched_requests`` tallies
+        and a batch-occupancy summary derived from the
+        ``serve.batch_occupancy.hist`` registry histogram (``{}`` until
+        the first coalesced dispatch).  Like the engine counters these
+        are PROCESS-global tallies — a second batching server in one
+        process inherits its predecessor's totals."""
+        pol = self.batching
+        if pol is None:
+            return {}
+        ec = _engine.counters()
+        occ = {}
+        snap = self._h_occ.snapshot()
+        if snap["count"]:
+            occ = {"dispatches": snap["count"],
+                   "mean": round(snap["sum"] / snap["count"], 2),
+                   "buckets": [(b, c) for b, c in self._h_occ.buckets()
+                               if c]}
+        return {"max_batch": pol.max_batch,
+                "linger": pol.linger,
+                "buckets": pol.buckets,
+                "batched_dispatches": ec["batched_dispatches"],
+                "batched_requests": ec["batched_requests"],
+                "occupancy": occ}
 
     def close(self, wait=True):
         """Stop the server.  ``wait=True`` drains queued jobs first and
@@ -964,6 +1387,9 @@ class Server:
             # stays attached (artifacts keep serving), only the
             # persistent_warm_hits arming ends
             _engine.disarm_warm_start()
+        if self._batched is not None:
+            self._batched.disarm()     # closes the lazy-reduce door
+            self._batched = None       # (idempotent across re-close)
 
     def __enter__(self):
         return self
@@ -982,7 +1408,7 @@ _ACTIVE_LOCK = threading.Lock()
 
 def start(workers=None, budget_bytes=None, queue_limit=None,
           policy="queue", weights=None, start_warm=None,
-          supervise=False):
+          supervise=False, batching=None):
     """Start and install THE process server (at most one may be active
     — the arbiter is only a global budget if there is one of it).
     Returns the :class:`Server`."""
@@ -995,7 +1421,7 @@ def start(workers=None, budget_bytes=None, queue_limit=None,
         _ACTIVE = Server(workers=workers, budget_bytes=budget_bytes,
                          queue_limit=queue_limit, policy=policy,
                          weights=weights, start_warm=start_warm,
-                         supervise=supervise)
+                         supervise=supervise, batching=batching)
         return _ACTIVE
 
 
@@ -1037,7 +1463,7 @@ def submit(pipeline, tenant="default", retries=0, deadline=None):
 @contextlib.contextmanager
 def serving(workers=None, budget_bytes=None, queue_limit=None,
             policy="queue", weights=None, start_warm=None,
-            supervise=False):
+            supervise=False, batching=None):
     """Scoped server lifetime::
 
         with bolt_tpu.serve.serving(workers=4) as sv:
@@ -1053,10 +1479,15 @@ def serving(workers=None, budget_bytes=None, queue_limit=None,
     pod recovery supervisor (``parallel.supervisor``) — peer death and
     rejoin reform the pod automatically, held ``retries=`` re-attempts
     resume from the checkpoint, and the arbiter budget tracks the
-    surviving capacity share."""
+    surviving capacity share; ``batching=True`` (or a
+    :class:`BatchPolicy` / dict of its kwargs) arms continuous
+    micro-batching — queued same-key small requests coalesce into ONE
+    stacked dispatch, bit-identical to standalone, at bucketed
+    widths."""
     sv = start(workers=workers, budget_bytes=budget_bytes,
                queue_limit=queue_limit, policy=policy, weights=weights,
-               start_warm=start_warm, supervise=supervise)
+               start_warm=start_warm, supervise=supervise,
+               batching=batching)
     try:
         yield sv
     except BaseException:

@@ -441,6 +441,34 @@ class _WithKeysFunc:
         return type(other) is _WithKeysFunc and self.func == other.func
 
 
+def _reduce_tree_expr(data, func, funcs, split, n, vshape, keepdims):
+    """The fixed-order pairwise-tree reduction expression — ONE traced
+    body shared by the eager ``reduce`` program, the lazy reduce
+    handle's standalone resolution (``bolt_tpu/tpu/multistat.py``) and
+    the serve layer's batched (vmapped) program
+    (``bolt_tpu/tpu/batched.py``), so every form computes bit-identical
+    results.  Applies the deferred chain, folds the flattened records
+    pairwise, validates the reducer's value shape, and restores
+    ``keepdims`` key axes; the caller applies the sharding constraint."""
+    mapped = _chain_apply(funcs, split, data)
+    x = mapped.reshape((n,) + mapped.shape[split:])
+    vfunc = jax.vmap(func)
+    while x.shape[0] > 1:
+        half = x.shape[0] // 2
+        combined = vfunc(x[:half], x[half:2 * half])
+        rem = x[2 * half:]
+        x = jnp.concatenate([combined, rem], axis=0) if rem.shape[0] \
+            else combined
+    out = x[0]
+    if out.shape != tuple(vshape):
+        raise ValueError(
+            "reduce produced shape %s, expected value shape %s"
+            % (out.shape, tuple(vshape)))
+    if keepdims:
+        out = out.reshape((1,) * split + tuple(vshape))
+    return out
+
+
 def _chain_apply(funcs, split, data):
     """Apply a deferred map chain: each func nested-vmapped over the
     ``split`` leading key axes, in order; ``with_keys`` entries vmap
@@ -836,6 +864,16 @@ class BoltArrayTPU(BoltArray):
         data with an empty chain."""
         return self._chain if self.deferred else (self._data, ())
 
+    def _adopt_materialised(self, data):
+        """Adopt ``data`` as this deferred chain's materialised result —
+        the scatter half of a serve BATCHED dispatch
+        (``bolt_tpu/tpu/batched.py``): the lane's output is exactly what
+        the standalone ``("chain", ...)`` program would have produced,
+        so the chain is simply retired."""
+        self._concrete = data
+        self._aval = jax.ShapeDtypeStruct(tuple(data.shape), data.dtype)
+        self._chain = None
+
     @property
     def keys(self):
         """Key-axis shape view (reference: ``bolt/spark/shapes.py :: Keys``)."""
@@ -1106,6 +1144,17 @@ class BoltArrayTPU(BoltArray):
             out = _streamlib.maybe_reduce(self, func, tuple(axes), keepdims)
             if out is not NotImplemented:
                 return out
+        # lazy door while a batching-enabled serving layer is armed
+        # (bolt_tpu/tpu/multistat.py): a full-key-axis reduce over a
+        # plain chain defers as a pending handle so the serve scheduler
+        # can coalesce same-shape requests into ONE batched dispatch;
+        # standalone resolution reuses the EXACT eager program (same
+        # engine key, same traced tree), so results and caching are
+        # unchanged.  NotImplemented falls through to the eager path.
+        from bolt_tpu.tpu import multistat as _ms
+        out = _ms.defer_reduce(self, func, tuple(axes), keepdims)
+        if out is not NotImplemented:
+            return out
         aligned = self._align(axes)
         split = aligned._split
         kshape = aligned.shape[:split]
@@ -1139,21 +1188,8 @@ class BoltArrayTPU(BoltArray):
 
         def build():
             def reducer(data):
-                mapped = _chain_apply(funcs, split, data)
-                x = mapped.reshape((n,) + mapped.shape[split:])
-                vfunc = jax.vmap(func)
-                while x.shape[0] > 1:
-                    half = x.shape[0] // 2
-                    combined = vfunc(x[:half], x[half:2 * half])
-                    rem = x[2 * half:]
-                    x = jnp.concatenate([combined, rem], axis=0) if rem.shape[0] else combined
-                out = x[0]
-                if out.shape != vshape:
-                    raise ValueError(
-                        "reduce produced shape %s, expected value shape %s"
-                        % (out.shape, vshape))
-                if keepdims:
-                    out = out.reshape((1,) * split + vshape)
+                out = _reduce_tree_expr(data, func, funcs, split, n,
+                                        vshape, keepdims)
                 return _constrain(out, mesh, new_split)
             return jax.jit(reducer, donate_argnums=(0,) if donate else ())
 

@@ -152,7 +152,7 @@ class _StatGroup:
 
     __slots__ = ("kind", "mesh", "split", "base", "funcs", "fpending",
                  "source", "donate", "in_aval", "members", "dispatched",
-                 "lock")
+                 "lock", "rfunc", "claimed", "claim_event")
 
     def __init__(self, kind, mesh, split, base=None, funcs=(),
                  fpending=None, source=None, donate=False, in_aval=None):
@@ -168,6 +168,17 @@ class _StatGroup:
         self.members = []
         self.dispatched = False
         self.lock = threading.Lock()
+        # a chain group carrying a deferred reduce(func) terminal
+        # (bolt_tpu/tpu/batched.py's lazy door): singleton, never joined
+        # by stat members — its standalone resolution is the EXACT eager
+        # reduce program
+        self.rfunc = None
+        # serve micro-batching claim (bolt_tpu/tpu/batched.py): while a
+        # batched dispatch owns this group, resolve() WAITS on the claim
+        # event instead of dispatching standalone, and try_join declines
+        # new members (they could never ride the already-shaped batch)
+        self.claimed = False
+        self.claim_event = None
 
     # -- joining -------------------------------------------------------
 
@@ -176,6 +187,10 @@ class _StatGroup:
         geometry; returns a new member handle, or NotImplemented when
         the spec cannot ride this group's fused program (the caller
         falls back to the eager path)."""
+        if self.rfunc is not None:
+            # a deferred-reduce group is singleton by contract: its one
+            # slot is the reduce tree, which no stat member can share
+            return NotImplemented
         if self.kind == "stream":
             h = _stream_member(self, name, axis, keepdims, ddof)
         elif self.kind == "fpending":
@@ -184,11 +199,12 @@ class _StatGroup:
             h = _chain_member(self, name, axis, keepdims, ddof)
         if h is not NotImplemented:
             with self.lock:
-                if self.dispatched:
-                    # a concurrent reader resolved the group between
-                    # the caller's check and this append: the new
-                    # member would never be filled — decline, the
-                    # caller starts a fresh group / eager path
+                if self.dispatched or self.claimed:
+                    # a concurrent reader resolved the group (or a serve
+                    # batched dispatch claimed it) between the caller's
+                    # check and this append: the new member would never
+                    # be filled — decline, the caller starts a fresh
+                    # group / eager path
                     return NotImplemented
                 self.members.append(h)
         return h
@@ -199,25 +215,76 @@ class _StatGroup:
         """Dispatch the group's program(s), filling every member's
         ``result``.  Idempotent and thread-safe; ``accumulate`` is the
         per-call reduced-precision override (``bolt.compute``'s
-        kwarg)."""
-        with self.lock:
-            if self.dispatched:
-                return
-            mode = _precision.resolve_accumulate(accumulate)
-            if mode is not None and self.kind != "chain":
-                if accumulate is not None:
-                    raise ValueError(
-                        "accumulate=%r applies to in-memory fused "
-                        "reductions only; this group streams/filters "
-                        "(%s) and runs exact" % (accumulate, self.kind))
-                mode = None             # ambient scope: exact fallback
-            if self.kind == "chain":
-                self._resolve_chain(mode)
-            elif self.kind == "fpending":
-                self._resolve_fpending()
-            else:
-                self._resolve_stream()
-            self.dispatched = True
+        kwarg).  While a serve batched dispatch holds this group's
+        CLAIM (bolt_tpu/tpu/batched.py), a concurrent reader waits for
+        the batched fill (or the unclaim, after which it dispatches
+        standalone) instead of double-dispatching."""
+        while True:
+            with self.lock:
+                if self.dispatched:
+                    return
+                ev = self.claim_event if self.claimed else None
+                if ev is None:
+                    mode = _precision.resolve_accumulate(accumulate)
+                    if mode is not None and self.rfunc is not None:
+                        # reduce(func) IGNORES accumulate and runs
+                        # exact, deferred or not — exactly what the
+                        # eager path always did (compute(handle,
+                        # accumulate=...) must not start raising just
+                        # because a batching server armed the lazy
+                        # door)
+                        mode = None
+                    elif mode is not None and self.kind != "chain":
+                        if accumulate is not None:
+                            raise ValueError(
+                                "accumulate=%r applies to in-memory "
+                                "fused reductions only; this group "
+                                "streams/filters (%s) and runs exact"
+                                % (accumulate, self.kind))
+                        mode = None     # ambient scope: exact fallback
+                    if self.rfunc is not None:
+                        self._resolve_reduce()
+                    elif self.kind == "chain":
+                        self._resolve_chain(mode)
+                    elif self.kind == "fpending":
+                        self._resolve_fpending()
+                    else:
+                        self._resolve_stream()
+                    self.dispatched = True
+                    return
+            # claimed by a serve batched dispatch on a worker thread:
+            # wait for the fill/unclaim and re-check (the timeout only
+            # bounds a claim owner dying without its unclaim finally)
+            ev.wait(1.0)
+
+    def _resolve_reduce(self):
+        """Standalone resolution of a deferred ``reduce(func)`` handle:
+        the EXACT eager reduce program — same engine key (donate=False,
+        the lazy door refuses donating chains), same traced pairwise
+        tree (`array._reduce_tree_expr`)."""
+        from bolt_tpu.tpu.array import _check_live, _constrain, \
+            _reduce_tree_expr
+        m = self.members[0]
+        func = self.rfunc
+        base, funcs, split, mesh = (self.base, self.funcs, self.split,
+                                    self.mesh)
+        shape = tuple(self.in_aval.shape)
+        n = prod(shape[:split])
+        vshape = shape[split:]
+        keepdims = m.keepdims
+
+        def build():
+            def reducer(data):
+                out = _reduce_tree_expr(data, func, funcs, split, n,
+                                        vshape, keepdims)
+                return _constrain(out, mesh, m.new_split)
+            return jax.jit(reducer)
+
+        fn = _cached_jit(("reduce", func, funcs, base.shape,
+                          str(base.dtype), split, keepdims, False, mesh),
+                         build)
+        with _obs.span("array.reduce", funcs=len(funcs), donate=False):
+            m.result = fn(_check_live(base))
 
     def _resolve_chain(self, mode):
         from bolt_tpu.tpu.array import _check_live, _chain_apply, \
@@ -261,14 +328,9 @@ class _StatGroup:
 
         def build():
             def stat(data):
-                mapped = _chain_apply(funcs, split, data)
-                outs = []
-                for (name, axes, keepdims, ddof) in slots:
-                    outs.append(_constrain(
-                        _stat_expr(mapped, name, axes, keepdims, ddof,
-                                   mode),
-                        mesh, nsplit[(name, axes, keepdims, ddof)]))
-                return tuple(outs)
+                outs = _chain_stat_exprs(data, funcs, split, slots, mode)
+                return tuple(_constrain(o, mesh, nsplit[s])
+                             for o, s in zip(outs, slots))
             return jax.jit(stat, donate_argnums=(0,) if donate else ())
 
         fn = _cached_jit(("multi-stat", slots, funcs, base.shape,
@@ -375,6 +437,19 @@ class _StatGroup:
 def _new_split(split, axes, keepdims):
     nkeys = sum(1 for a in axes if a < split)
     return split if keepdims else split - nkeys
+
+
+def _chain_stat_exprs(data, funcs, split, slots, mode):
+    """The UNCONSTRAINED per-slot reduction expressions over one chain
+    input — the shared body of the fused multi-stat program above AND
+    the serve layer's batched (vmapped) program
+    (``bolt_tpu/tpu/batched.py``): one traced arithmetic, so a batched
+    lane computes bit-identically to its standalone dispatch.  The
+    caller applies the per-slot sharding constraint."""
+    from bolt_tpu.tpu.array import _chain_apply
+    mapped = _chain_apply(funcs, split, data)
+    return tuple(_stat_expr(mapped, name, axes, keepdims, ddof, mode)
+                 for (name, axes, keepdims, ddof) in slots)
 
 
 def _stat_expr(mapped, name, axes, keepdims, ddof, mode):
@@ -576,6 +651,66 @@ def _stream_member(g, name, axis, keepdims, ddof):
         lambda x: _OPS[name](x, axis=0, **kwargs), probe)
     return PendingStat(g, name, tuple(range(st.split)), False, ddof,
                        aval, 0)
+
+
+def defer_reduce(arr, func, axes, keepdims):
+    """Lazy door for ``reduce(func)`` — armed ONLY while a
+    batching-enabled serving layer is active (``bolt_tpu.serve``
+    ``Server(batching=...)`` arms ``bolt_tpu/tpu/batched.py``): a
+    full-key-axis reduce over a plain chain/concrete source defers as a
+    singleton pending-handle group so the serve scheduler can coalesce
+    same-shape requests into ONE batched dispatch.  Standalone
+    resolution is the EXACT eager program (same key, same traced tree),
+    so a deferred handle read outside any batch is byte-for-byte the
+    eager terminal.  Returns ``NotImplemented`` (→ the eager path) when
+    the door is unarmed or the geometry does not fit: misaligned axes,
+    streams/filters/pending compactions, donating chains (donation
+    semantics stay eager), non-traceable reducers, or a reducer whose
+    output drifts from the value shape (the eager call-time error
+    contract is preserved)."""
+    import sys as _sys
+    bt = _sys.modules.get("bolt_tpu.tpu.batched")
+    if bt is None or not bt.armed():
+        return NotImplemented
+    if (arr._donated or arr._stream is not None
+            or arr._fpending is not None or arr._pending is not None
+            or arr._stat_group is not None):
+        return NotImplemented
+    split = arr._split
+    if split == 0 or tuple(axes) != tuple(range(split)):
+        return NotImplemented
+    shape = tuple(arr._aval.shape)
+    n = prod(shape[:split])
+    if n == 0:
+        return NotImplemented          # eager empty-reduce raise contract
+    vshape = shape[split:]
+    dtype = arr._aval.dtype
+    from bolt_tpu.tpu.array import _TRACE_ERRORS, _cached_eval_shape, \
+        _chain_donate_ok
+    vaval = jax.ShapeDtypeStruct(vshape, dtype)
+    try:
+        oav = _cached_eval_shape(
+            ("reduce", func, vshape, str(vaval.dtype)),
+            lambda: jax.eval_shape(func, vaval, vaval))
+    except _TRACE_ERRORS:
+        return NotImplemented          # host-fallback path resolves
+    if tuple(oav.shape) != tuple(vshape):
+        return NotImplemented          # eager call-time ValueError
+    if arr.deferred and _chain_donate_ok(arr._chain):
+        return NotImplemented          # keep the donating eager terminal
+    base, funcs = arr._chain_parts()
+    g = _StatGroup("chain", arr._mesh, split, base=base, funcs=funcs,
+                   donate=False,
+                   in_aval=jax.ShapeDtypeStruct(shape, dtype))
+    g.rfunc = func
+    new_split = split if keepdims else 0
+    aval = jax.ShapeDtypeStruct(
+        ((1,) * split + tuple(vshape)) if keepdims else tuple(vshape),
+        oav.dtype)
+    m = PendingStat(g, "reduce", tuple(axes), keepdims, None, aval,
+                    new_split)
+    g.members.append(m)
+    return _wrap(arr, g, m)
 
 
 # ---------------------------------------------------------------------

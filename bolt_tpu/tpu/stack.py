@@ -154,43 +154,12 @@ class StackedArray:
 
         def build():
             def run(data):
+                # ONE traced body — _stack_map_body above — serves this
+                # materialised program, the streaming executor's
+                # per-slab program AND (as the pattern) the serve
+                # layer's batched programs: parity by construction
                 data = _chain_apply(funcs, split, data)
-                flat = data.reshape((n,) + vshape)
-                if n == 0:
-                    # zero records (a filter with no survivors): func never
-                    # runs, but the empty output must still carry the
-                    # value shape/dtype func WOULD produce so empty and
-                    # non-empty branches of one pipeline stay consistent
-                    ob = jax.eval_shape(func, jax.ShapeDtypeStruct(
-                        (size,) + vshape, flat.dtype))
-                    out = jnp.zeros(kshape + tuple(ob.shape[1:]),
-                                    canon or ob.dtype)
-                    return _constrain(out, mesh, split)
-                nfull = n // size
-                outs = []
-                if nfull:
-                    blocks = flat[:nfull * size].reshape(
-                        (nfull, size) + vshape)
-                    out = jax.vmap(func)(blocks)
-                    if out.ndim < 2 or out.shape[:2] != (nfull, size):
-                        got = out.shape[1] if out.ndim >= 2 else "none"
-                        raise ValueError(
-                            "stacked map must preserve the record count: "
-                            "block of %d records -> %s" % (size, got))
-                    outs.append(out.reshape((nfull * size,) + out.shape[2:]))
-                if n % size:
-                    tail = flat[nfull * size:]
-                    tout = func(tail)
-                    if tout.shape[0] != tail.shape[0]:
-                        raise ValueError(
-                            "stacked map must preserve the record count: "
-                            "block of %d records -> %d"
-                            % (tail.shape[0], tout.shape[0]))
-                    outs.append(tout)
-                out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
-                out = out.reshape(kshape + out.shape[1:])
-                if canon is not None:
-                    out = out.astype(canon)   # fused into the same program
+                out = _stack_map_body(data, func, split, size, canon)
                 return _constrain(out, mesh, split)
             return jax.jit(run, donate_argnums=(0,) if donate else ())
 

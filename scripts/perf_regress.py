@@ -25,6 +25,11 @@ Usage::
     python scripts/perf_regress.py --trace out.json  # + obs timeline:
         # Chrome trace-event export of the whole run, and each family's
         # PERF.json entry gains a span-derived "phases" breakdown
+    python scripts/perf_regress.py --families=a,b    # measure a subset:
+        # comma list of family names; a token "platform:cpu" expands to
+        # every committed family whose last entry was measured on that
+        # backend — so a real-chip window re-measures exactly the
+        # container-tagged families without a full sweep
 """
 
 import json
@@ -427,6 +432,115 @@ def fam_multi_stat_fused():
                          "multi_stat_fused bench gate enforces")}
 
 
+def fam_serve_smallreq():
+    # the ISSUE-13 continuous micro-batching family: a firehose of
+    # SMALL same-shape map->sum requests against ONE serve worker,
+    # where per-request dispatch overhead (program launch + the
+    # 8-device collective rendezvous), not bytes, is the roofline.
+    # s_per_iter is the BATCHED saturated drain wall (queue pre-filled
+    # behind a parked worker = high offered QPS; the drain measures
+    # aggregate server throughput); the family records the unbatched
+    # drain, the batched-over-unbatched scaling factor (the >= 3x
+    # acceptance gate), p50/p99 latency at a sweep of offered QPS for
+    # BOTH modes (the low-QPS p50 must hold < 1.2x with batching
+    # armed), realised batch occupancy, and dispatches-per-request.
+    import threading
+    from bolt_tpu import serve as _serve
+    from bolt_tpu.tpu import batched as _batched
+    shape = (128, 32)
+    nreq, nb = 256, 8
+    bs = [bolt.randn(shape, mode="tpu", seed=140 + i,
+                     dtype=np.float32).cache() for i in range(nb)]
+
+    def make(i=0):
+        return bs[i % nb].map(MAPSUM_FN).sum()
+
+    for i in range(nb):
+        jax.device_get(_tiny(make(i).cache().tojax()))
+
+    def saturated(sv):
+        # server-side drain window: gate opening -> last finished_s
+        # (the client's result-collection loop stays outside)
+        best = float("inf")
+        for _ in range(3):
+            gate = threading.Event()
+            blocker = sv.submit(gate.wait)       # parks the ONE worker
+            futs = [sv.submit(make(i), tenant="t%d" % (i % 4))
+                    for i in range(nreq)]
+            t0 = time.perf_counter()
+            gate.set()
+            [f.result(timeout=600) for f in futs]
+            best = min(best, max(f.finished_s for f in futs) - t0)
+            blocker.result(timeout=30)
+        return best
+
+    def qps_curve(sv, levels=(100, 1000, 100000), n=24):
+        curve = {}
+        [sv.submit(make()).result(timeout=60) for _ in range(5)]
+        for qps in levels:
+            period = 1.0 / qps
+            futs = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                futs.append(sv.submit(make(i), tenant="t%d" % (i % 4)))
+                dt = period - (time.perf_counter() - t0)
+                if dt > 0:
+                    time.sleep(dt)
+            for f in futs:
+                f.result(timeout=120)
+            lats = sorted(f.finished_s - f.submitted_s for f in futs)
+            curve[str(qps)] = {
+                "p50_s": round(lats[len(lats) // 2], 6),
+                "p99_s": round(lats[min(len(lats) - 1,
+                                        int(len(lats) * 0.99))], 6)}
+        return curve
+
+    with _serve.serving(workers=1, queue_limit=2 * nreq) as sv:
+        [f.result(timeout=60) for f in
+         [sv.submit(make(i)) for i in range(16)]]
+        unbatched = saturated(sv)
+        curve_off = qps_curve(sv)
+    with _serve.serving(workers=1, queue_limit=2 * nreq,
+                        batching={"max_batch": 16,
+                                  "linger": 0.002}) as sv:
+        _batched.warm(make, buckets=sv.batching.buckets)
+        [f.result(timeout=60) for f in
+         [sv.submit(make(i)) for i in range(16)]]
+        # the counter window covers ONLY the saturated drain rounds:
+        # warm()'s throwaway bucket dispatches, the warmup submits and
+        # the qps-curve traffic must not contaminate the recorded
+        # occupancy/dispatch metrics
+        ec0 = bolt.profile.engine_counters()
+        batched = saturated(sv)
+        ec1 = bolt.profile.engine_counters()
+        curve_on = qps_curve(sv)
+        occ = (sv.stats()["batching"].get("occupancy") or {})
+    dreq = max(1, ec1["batched_requests"] - ec0["batched_requests"])
+    nbytes = int(np.prod(shape)) * 4
+    return nreq * nbytes, batched, {
+        "bound": "dispatch",
+        "requests": nreq,
+        "unbatched_s": round(unbatched, 5),
+        "batched_over_unbatched": round(unbatched / batched, 2),
+        "batch_occupancy_mean": occ.get("mean"),
+        "dispatches_per_request": round(
+            (ec1["dispatches"] - ec0["dispatches"]) / float(dreq), 4),
+        "batched_dispatches": ec1["batched_dispatches"]
+        - ec0["batched_dispatches"],
+        "batched_requests": ec1["batched_requests"]
+        - ec0["batched_requests"],
+        "qps_curve_batched": curve_on,
+        "qps_curve_unbatched": curve_off,
+        "p50_low_qps_ratio": round(
+            curve_on["100"]["p50_s"] / curve_off["100"]["p50_s"], 3),
+        "traffic": (1.0, "N tiny same-shape requests; throughput is "
+                         "bounded by per-request dispatch overhead, "
+                         "which the coalesced stacked dispatch "
+                         "amortises across the bucket width — the "
+                         "gbps figure is incidental (requests are "
+                         "KB-scale)")}
+
+
 def fam_serve_multitenant():
     # the ISSUE-8 multi-tenant serving layer: N tenants submit
     # IDENTICAL streamed reductions over storage-latency-bound sources
@@ -471,12 +585,32 @@ def fam_serve_multitenant():
             [f.result(timeout=600) for f in futs]
             best = min(best, time.perf_counter() - t0)
             lats += [f.finished_s - f.submitted_s for f in futs]
+        # p50/p99-vs-offered-QPS (ISSUE 13 rides along): jobs paced at
+        # each offered rate, latency distribution per level — the
+        # saturation knee is where p99 detaches from p50
+        curve = {}
+        for qps in (1, 4, 16):
+            period = 1.0 / qps
+            cfuts = []
+            for i in range(8):
+                t0 = time.perf_counter()
+                cfuts.append(sv.submit(make(), tenant="t%d" % (i % 4)))
+                dt = period - (time.perf_counter() - t0)
+                if dt > 0:
+                    time.sleep(dt)
+            for f in cfuts:
+                f.result(timeout=600)
+            clats = sorted(f.finished_s - f.submitted_s for f in cfuts)
+            curve[str(qps)] = {
+                "p50_s": round(clats[len(clats) // 2], 5),
+                "p99_s": round(clats[-1], 5)}
         st = sv.stats()
     lats.sort()
     nbytes = int(np.prod(shape)) * 4
     return tenants * nbytes, best, {
         "bound": "transfer",
         "tenants": tenants,
+        "qps_curve": curve,
         "p50_s": round(lats[len(lats) // 2], 5),
         "p99_s": round(lats[min(len(lats) - 1,
                                 int(len(lats) * 0.99))], 5),
@@ -728,6 +862,7 @@ FAMILIES = [
     ("stream_sum", fam_stream_sum),
     ("multi_stat_fused", fam_multi_stat_fused),
     ("serve_multitenant", fam_serve_multitenant),
+    ("serve_smallreq", fam_serve_smallreq),
     ("stream_resume", fam_stream_resume),
     ("multihost_stream", fam_multihost_stream),
     ("multihost_resume", fam_multihost_resume),
@@ -792,6 +927,48 @@ def main():
     for arg in sys.argv[1:]:
         if arg.startswith("--only="):
             only = set(arg.split("=", 1)[1].split(","))
+        elif arg.startswith("--families="):
+            # the targeted re-measurement door (ISSUE 13 satellite): a
+            # comma list of family names, each token either a literal
+            # name or "platform:<tag>" — the latter expands to every
+            # committed family whose last PERF.json/baseline entry was
+            # measured on that backend, so a future real-chip window
+            # can re-run exactly the platform-"cpu"-tagged families
+            # (`--families=platform:cpu`) without a full sweep
+            sel = set()
+            committed = {}
+            for path in (BASE, OUT):
+                if os.path.exists(path):
+                    with open(path) as f:
+                        committed.update(json.load(f))
+            known = {name for name, _ in FAMILIES}
+            literal = set()
+            for tok in arg.split("=", 1)[1].split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                if tok.startswith("platform:"):
+                    plat = tok.split(":", 1)[1]
+                    # expansion keeps only families that still EXIST —
+                    # a stale committed entry must not fail the run
+                    sel |= {name for name, entry in committed.items()
+                            if name in known and isinstance(entry, dict)
+                            and entry.get("platform") == plat}
+                else:
+                    literal.add(tok)
+                    sel.add(tok)
+            unknown = sorted(literal - known)
+            if unknown:
+                print("--families: unknown famil%s %s (known: %s)"
+                      % ("y" if len(unknown) == 1 else "ies",
+                         ",".join(unknown),
+                         ",".join(sorted(known))), file=sys.stderr)
+                return 1
+            only = sel if only is None else (only | sel)
+            if not only:
+                print("--families matched nothing (token list: %r)"
+                      % arg.split("=", 1)[1], file=sys.stderr)
+                return 1
     # start from the committed baseline plus any previous partial
     # measurement (fresher wins), so a run cut short by a wall-clock
     # budget (remote-attach variance is 2-10x) resumes instead of losing
@@ -872,7 +1049,17 @@ def main():
                     "rejoin_seconds", "attach_seconds",
                     "precollective_seconds", "scenario_over_clean",
                     "rejoined", "nproc_final", "resumes_a",
-                    "resumes_b", "stale_markers"):
+                    "resumes_b", "stale_markers",
+                    # serve_smallreq (ISSUE 13): continuous
+                    # micro-batching observables — aggregate scaling,
+                    # occupancy, amortised dispatch count, and the
+                    # p50/p99-vs-offered-QPS curves for both modes
+                    # (serve_multitenant gains "qps_curve" too)
+                    "requests", "unbatched_s", "batched_over_unbatched",
+                    "batch_occupancy_mean", "dispatches_per_request",
+                    "batched_dispatches", "batched_requests",
+                    "qps_curve", "qps_curve_batched",
+                    "qps_curve_unbatched", "p50_low_qps_ratio"):
             if meta.get(key) is not None:
                 entry[key] = meta[key]
         if phases:

@@ -309,6 +309,7 @@ _EXPECTED_ENGINE_KEYS = {
     "checkpoint_bytes": False, "checkpoint_seconds": True,
     "fused_stat_groups": False, "fused_stat_terminals": False,
     "coalesced_builds": False, "coalesced_compiles": False,
+    "batched_dispatches": False, "batched_requests": False,
 }
 
 

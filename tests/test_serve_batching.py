@@ -1,0 +1,648 @@
+"""Continuous micro-batching (ISSUE 13): batch keys, coalesced
+dispatch, bit-identity, bucketing, attribution, degradation.
+
+The load-bearing contracts: queued requests sharing a batch key (same
+pipeline structure, shapes, dtypes, terminal and sharding — across
+tenants) coalesce into ONE stacked dispatch whose every lane is
+BIT-IDENTICAL to its standalone dispatch; partial batches pad to
+bucketed widths so steady state runs zero fresh XLA compiles; a lone
+request takes the standalone path untouched; per-request and
+per-tenant attribution survive coalescing; any claim/dispatch failure
+degrades every request to its standalone dispatch (batching is an
+optimisation, never a new failure mode); and ``Server.stop`` with
+queued-but-unstarted requests fails their futures pointedly — no hang,
+zero arbiter bytes leaked — batched or not.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import bolt_tpu as bolt
+from bolt_tpu import analysis, engine, serve
+from bolt_tpu.tpu import batched
+
+pytestmark = pytest.mark.serve
+
+
+ADD1 = lambda v: v + 1        # hoisted: same-key requests must share
+MUL2 = lambda v: v * 2        # stage callables (identity-keyed)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_server():
+    yield
+    assert serve.active() is None, "a test leaked an active server"
+
+
+def _bases(mesh, n=6, shape=(32, 8)):
+    return [bolt.array(
+        np.random.RandomState(i).randn(*shape).astype(np.float32),
+        mesh).cache() for i in range(n)]
+
+
+# ---------------------------------------------------------------------
+# the batch key
+# ---------------------------------------------------------------------
+
+def test_batch_key_equality_and_difference(mesh):
+    bs = _bases(mesh, 2)
+    k1 = batched.batch_key(bs[0].map(ADD1).sum())
+    k2 = batched.batch_key(bs[1].map(ADD1).sum())
+    assert k1 is not None and k1 == k2          # same shape/func/terminal
+    # terminal differs
+    assert batched.batch_key(bs[0].map(ADD1).min()) != k1
+    # chain differs (different callable identity)
+    assert batched.batch_key(bs[0].map(MUL2).sum()) != k1
+    # shape differs
+    other = bolt.array(np.ones((16, 8), np.float32), mesh)
+    assert batched.batch_key(other.map(ADD1).sum()) != k1
+    # axis spec differs
+    assert batched.batch_key(bs[0].map(ADD1).sum(axis=(0, 1))) != k1
+    # the chain-materialise form is its own key family
+    kc = batched.batch_key(bs[0].map(ADD1))
+    assert kc is not None and kc[0] == "chain" and kc != k1
+
+
+def test_batch_key_ineligible_shapes(mesh):
+    bs = _bases(mesh, 1)
+    # deferred filter: no key
+    assert batched.batch_key(bs[0].filter(lambda v: v.sum() > 0)) is None
+    # concrete array (nothing lazy): no key
+    assert batched.batch_key(bs[0]) is None
+    # streaming source: no key (streams batch per slab in the executor)
+    x = np.ones((16, 8), np.float32)
+    src = bolt.fromcallback(lambda idx: x[idx], (16, 8), mesh,
+                            dtype=np.float32, chunks=4)
+    assert batched.batch_key(src.map(ADD1).sum()) is None
+    # a donating chain refuses to batch (donation semantics stay eager)
+    with engine.donation(0):
+        donating = bolt.array(x, mesh).map(ADD1)
+        assert batched.batch_key(donating.sum()) is None
+
+
+# ---------------------------------------------------------------------
+# coalesced dispatch: bit-identity, bucketing, counters
+# ---------------------------------------------------------------------
+
+def test_batched_stat_bit_identical_and_counted(mesh):
+    bs = _bases(mesh)
+    refs = [np.asarray(b.map(ADD1).sum().toarray()) for b in bs]
+    c0 = engine.counters()
+    with serve.serving(workers=2, batching={"max_batch": 8,
+                                            "linger": 0.02}) as sv:
+        futs = [sv.submit(bs[i % 6].map(ADD1).sum(),
+                          tenant="t%d" % (i % 3)) for i in range(12)]
+        outs = [np.asarray(f.result(timeout=120).toarray())
+                for f in futs]
+    c1 = engine.counters()
+    for i, out in enumerate(outs):
+        assert out.dtype == refs[i % 6].dtype
+        assert np.array_equal(out, refs[i % 6])
+    assert c1["batched_dispatches"] > c0["batched_dispatches"]
+    assert c1["batched_requests"] - c0["batched_requests"] >= 2
+    # coalesced futures carry their batch attribution
+    widths = [f.batch_width for f in futs if f.batch_width]
+    assert widths and all(w >= 2 for w in widths)
+    asm = [f.assembly_seconds for f in futs if f.batch_width]
+    assert all(a is not None and a >= 0 for a in asm)
+
+
+def test_partial_bucket_pads_and_stays_bit_identical(mesh):
+    bs = _bases(mesh, 3)
+    refs = [np.asarray(b.map(ADD1).sum().toarray()) for b in bs]
+    with serve.serving(workers=1, batching={"max_batch": 8,
+                                            "linger": 0.05}) as sv:
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)           # park the ONE worker
+        futs = [sv.submit(bs[i].map(ADD1).sum()) for i in range(3)]
+        gate.set()
+        outs = [np.asarray(f.result(timeout=120).toarray())
+                for f in futs]
+        blocker.result(timeout=30)
+    for out, ref in zip(outs, refs):
+        assert np.array_equal(out, ref)
+    # 3 requests pad into the 4-bucket: ONE coalesced dispatch
+    assert [f.batch_width for f in futs] == [3, 3, 3]
+
+
+def test_multistat_group_rides_one_batched_dispatch(mesh):
+    bs = _bases(mesh, 4)
+
+    def group(i):
+        m = bs[i].map(ADD1)
+        return m.sum(), m.var()
+
+    refs = []
+    for i in range(4):
+        s, v = bolt.compute(*group(i))
+        refs.append((np.asarray(s.toarray()), np.asarray(v.toarray())))
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.05}) as sv:
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        pairs = [group(i) for i in range(4)]
+        futs = [sv.submit(p[0]) for p in pairs]   # submit ONE member
+        gate.set()
+        outs_s = [np.asarray(f.result(timeout=120).toarray())
+                  for f in futs]
+        blocker.result(timeout=30)
+        # the sibling member resolved in the SAME batched dispatch
+        outs_v = [np.asarray(p[1].toarray()) for p in pairs]
+    for i in range(4):
+        assert np.array_equal(outs_s[i], refs[i][0])
+        assert np.array_equal(outs_v[i], refs[i][1])
+
+
+def test_chain_materialise_batched(mesh):
+    bs = _bases(mesh, 4)
+    refs = [np.asarray(b.map(ADD1).toarray()) for b in bs]
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.05}) as sv:
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        futs = [sv.submit(bs[i].map(ADD1)) for i in range(4)]
+        gate.set()
+        outs = [np.asarray(f.result(timeout=120).toarray())
+                for f in futs]
+        blocker.result(timeout=30)
+    for out, ref in zip(outs, refs):
+        assert np.array_equal(out, ref)
+    assert all(f.batch_width == 4 for f in futs)
+
+
+def test_zero_fresh_compiles_across_bucketed_widths(mesh):
+    bs = _bases(mesh)
+
+    def make(i=0):
+        return bs[i % 6].map(ADD1).sum()
+
+    make().cache()                     # standalone program
+    with serve.serving(workers=1, batching={"max_batch": 8,
+                                            "linger": 0.05}) as sv:
+        warmed = batched.warm(make, buckets=sv.batching.buckets)
+        assert warmed == (2, 4, 8)
+        c0 = engine.counters()
+        for burst in (1, 2, 3, 5, 8):  # every width buckets to 2/4/8
+            gate = threading.Event()
+            blocker = sv.submit(gate.wait)
+            futs = [sv.submit(make(i)) for i in range(burst)]
+            gate.set()
+            [f.result(timeout=120) for f in futs]
+            blocker.result(timeout=30)
+        c1 = engine.counters()
+    assert c1["misses"] == c0["misses"]
+    assert c1["aot_compiles"] == c0["aot_compiles"]
+
+
+def test_single_request_takes_the_standalone_path(mesh):
+    bs = _bases(mesh, 1)
+    ref = np.asarray(bs[0].map(ADD1).sum().toarray())
+    c0 = engine.counters()
+    with serve.serving(workers=1, batching=True) as sv:
+        f = sv.submit(bs[0].map(ADD1).sum())
+        out = np.asarray(f.result(timeout=120).toarray())
+    c1 = engine.counters()
+    assert np.array_equal(out, ref)
+    assert f.batch_width is None and f.assembly_seconds is None
+    assert c1["batched_dispatches"] == c0["batched_dispatches"]
+
+
+# ---------------------------------------------------------------------
+# the deferred reduce door
+# ---------------------------------------------------------------------
+
+def test_reduce_defers_only_under_a_batching_server(mesh):
+    bs = _bases(mesh, 4)
+    # no batching server: reduce is eager (concrete immediately)
+    out = bs[0].map(ADD1).reduce(jnp.add)
+    assert out._spending is None
+    ref = [np.asarray(b.map(ADD1).reduce(jnp.add).toarray()) for b in bs]
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.05}) as sv:
+        # armed: reduce defers as a pending handle...
+        lone = bs[0].map(ADD1).reduce(jnp.add)
+        assert lone._spending is not None
+        # ...whose standalone read is bit-identical to eager
+        assert np.array_equal(np.asarray(lone.toarray()), ref[0])
+        # and a queued burst coalesces, bit-identically
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        futs = [sv.submit(bs[i].map(ADD1).reduce(jnp.add))
+                for i in range(4)]
+        gate.set()
+        outs = [np.asarray(f.result(timeout=120).toarray())
+                for f in futs]
+        blocker.result(timeout=30)
+    for got, want in zip(outs, ref):
+        assert np.array_equal(got, want)
+    assert all(f.batch_width == 4 for f in futs)
+    # the server closed: the door is shut again
+    assert bs[0].map(ADD1).reduce(jnp.add)._spending is None
+
+
+def test_deferred_reduce_keeps_eager_error_contracts(mesh):
+    b = _bases(mesh, 1)[0]
+    with serve.serving(workers=1, batching=True):
+        # a reducer that breaks the value-shape contract must refuse
+        # the lazy door and raise at CALL time, like the eager path
+        with pytest.raises(ValueError, match="value shape"):
+            b.map(ADD1).reduce(lambda a, c: jnp.stack([a, c]))
+        # empty reduce raises eagerly too
+        empty = bolt.array(np.ones((0, 4), np.float32), mesh)
+        with pytest.raises(TypeError, match="empty"):
+            empty.reduce(jnp.add)
+    serve.stop()
+
+
+# ---------------------------------------------------------------------
+# attribution, fair share, stats
+# ---------------------------------------------------------------------
+
+def test_per_tenant_accounting_survives_coalescing(mesh):
+    bs = _bases(mesh, 4)
+    tenants = ("acct-a", "acct-b")     # unique: registry groups are
+    #                                    process-wide across tests
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.05}) as sv:
+        before = {t: sv.stats()["tenants"].get(t, {}) for t in tenants}
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        futs = [sv.submit(bs[i].map(ADD1).sum(),
+                          tenant=tenants[i % 2]) for i in range(4)]
+        gate.set()
+        [f.result(timeout=120) for f in futs]
+        blocker.result(timeout=30)
+        st = sv.stats()
+    for t in tenants:
+        entry = st["tenants"][t]
+        b4 = before[t]
+        assert entry["submitted"] - b4.get("submitted", 0) == 2
+        assert entry["completed"] - b4.get("completed", 0) == 2
+        assert entry["run_seconds"] > b4.get("run_seconds", 0.0)
+        assert entry["queue_wait_seconds"] >= 0.0
+
+
+def test_stats_batching_block_and_degraded_shapes(mesh):
+    from bolt_tpu.obs import metrics as _metrics
+    with serve.serving(workers=1) as sv:
+        assert sv.stats()["batching"] == {}       # documented degraded
+        #          shape on a server without a batching policy
+    _metrics.registry().histogram(
+        "serve.batch_occupancy.hist", lo=0, hi=9).reset()
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.01}) as sv:
+        st = sv.stats()["batching"]
+        assert st["max_batch"] == 4 and st["buckets"] == (2, 4)
+        assert st["occupancy"] == {}              # no coalesced dispatch
+        b = _bases(mesh, 2)
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        futs = [sv.submit(b[i].map(ADD1).sum(), tenant="statsq")
+                for i in (0, 1)]
+        # live queue depth is visible while parked
+        assert sv.stats()["tenants"]["statsq"]["queue_depth"] == 2
+        gate.set()
+        [f.result(timeout=120) for f in futs]
+        blocker.result(timeout=30)
+        assert sv.stats()["tenants"]["statsq"]["queue_depth"] == 0
+        occ = sv.stats()["batching"]["occupancy"]
+        assert occ["dispatches"] >= 1 and occ["mean"] >= 2
+
+
+def test_blt015_forecast_gated_on_a_batching_server(mesh):
+    b = _bases(mesh, 1)[0]
+    assert not analysis.check(b.map(ADD1).sum()).has("BLT015")
+    with serve.serving(workers=1, batching=True):
+        assert analysis.check(b.map(ADD1).sum()).has("BLT015")
+        assert analysis.check(b.map(ADD1)).has("BLT015")
+        # ineligible pipelines stay quiet
+        assert not analysis.check(
+            b.map(ADD1).filter(lambda v: v.sum() > 0)).has("BLT015")
+    serve.stop()
+    assert not analysis.check(b.map(ADD1).sum()).has("BLT015")
+
+
+def test_batch_policy_validation():
+    with pytest.raises(ValueError, match="max_batch"):
+        serve.BatchPolicy(max_batch=1)
+    with pytest.raises(ValueError, match="linger"):
+        serve.BatchPolicy(linger=-1)
+    with pytest.raises(ValueError, match="buckets"):
+        serve.BatchPolicy(buckets=(1, 2))
+    with pytest.raises(ValueError, match="bucket"):
+        serve.BatchPolicy(max_batch=16, buckets=(2, 4))
+    with pytest.raises(ValueError, match="bucket"):
+        # a bucket WIDER than max_batch would pad every dispatch past
+        # the promised widest width
+        serve.BatchPolicy(max_batch=4, buckets=(8,))
+    pol = serve.BatchPolicy(buckets=(4, 8))
+    assert pol.max_batch == 8 and pol.buckets == (4, 8)
+    with pytest.raises(ValueError, match="batching"):
+        serve.Server(batching="yes")
+
+
+# ---------------------------------------------------------------------
+# degradation and races: batching must never be a failure mode
+# ---------------------------------------------------------------------
+
+def test_dispatch_failure_degrades_to_standalone(mesh, monkeypatch):
+    bs = _bases(mesh, 4)
+    refs = [np.asarray(b.map(ADD1).sum().toarray()) for b in bs]
+
+    def boom(batch, buckets):
+        raise RuntimeError("injected batched-dispatch failure")
+
+    monkeypatch.setattr(batched, "dispatch", boom)
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.05}) as sv:
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        futs = [sv.submit(bs[i].map(ADD1).sum()) for i in range(4)]
+        gate.set()
+        outs = [np.asarray(f.result(timeout=120).toarray())
+                for f in futs]
+        blocker.result(timeout=30)
+        assert sv.stats()["arbiter"]["in_use_bytes"] == 0
+    for out, ref in zip(outs, refs):
+        assert np.array_equal(out, ref)       # standalone fallback ran
+    # degraded requests ran STANDALONE: no batch attribution
+    assert all(f.batch_width is None for f in futs)
+    assert all(f.assembly_seconds is None for f in futs)
+
+
+def test_concurrent_reader_waits_for_the_claimed_fill(mesh):
+    bs = _bases(mesh, 2)
+    refs = [np.asarray(b.map(ADD1).sum().toarray()) for b in bs]
+    arrs = [b.map(ADD1).sum() for b in bs]
+    key = batched.batch_key(arrs[0])
+    b = batched.claim(arrs, key)
+    assert b is not None
+    got = {}
+
+    def reader():
+        # resolve() during the claim window must WAIT for the batched
+        # fill, then adopt it — never double-dispatch
+        got["v"] = np.asarray(arrs[0].toarray())
+
+    th = threading.Thread(target=reader)
+    th.start()
+    time.sleep(0.05)
+    batched.dispatch(b, (2,))
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert np.array_equal(got["v"], refs[0])
+    assert np.array_equal(np.asarray(arrs[1].toarray()), refs[1])
+
+
+def test_partial_claim_keeps_the_healthy_majority(mesh):
+    # one raced member (its group resolved concurrently) must not cost
+    # the rest their coalescing: the batch serves the claimable subset
+    bs = _bases(mesh, 3)
+    refs = [np.asarray(b.map(ADD1).sum().toarray()) for b in bs]
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.05}) as sv:
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        arrs = [bs[i].map(ADD1).sum() for i in range(3)]
+        futs = [sv.submit(a) for a in arrs]
+        # a user thread resolves request 1 while it sits queued
+        raced = np.asarray(arrs[1].toarray())
+        gate.set()
+        outs = [np.asarray(f.result(timeout=120).toarray())
+                for f in futs]
+        blocker.result(timeout=30)
+    assert np.array_equal(raced, refs[1])
+    for out, ref in zip(outs, refs):
+        assert np.array_equal(out, ref)
+    # the two healthy requests coalesced; the raced one ran standalone
+    assert futs[1].batch_width is None
+    assert futs[0].batch_width == 2 and futs[2].batch_width == 2
+
+
+def test_unclaim_releases_readers_to_standalone(mesh):
+    bs = _bases(mesh, 2)
+    arrs = [b.map(ADD1).sum() for b in bs]
+    key = batched.batch_key(arrs[0])
+    b = batched.claim(arrs, key)
+    assert b is not None
+    batched.unclaim(b)
+    # un-claimed handles resolve standalone, bit-identically
+    for arr, base in zip(arrs, bs):
+        assert np.array_equal(np.asarray(arr.toarray()),
+                              np.asarray(base.map(ADD1).sum().toarray()))
+
+
+def test_claimed_group_declines_new_members(mesh):
+    b = _bases(mesh, 1)[0]
+    m = b.map(ADD1)
+    h = m.sum()
+    other = _bases(mesh, 2)[1].map(ADD1).sum()
+    bt = batched.claim([h, other], batched.batch_key(h))
+    assert bt is not None
+    # a sibling terminal arriving mid-claim starts a FRESH group
+    # (try_join declines) instead of joining one it could never ride
+    v = m.var()
+    assert v._spending is None or v._spending.group is not h._spending.group
+    batched.dispatch(bt, (2,))
+    assert np.array_equal(np.asarray(h.toarray()),
+                          np.asarray(b.map(ADD1).sum().toarray()))
+
+
+def test_deferred_reduce_ignores_accumulate_like_eager(mesh):
+    # eager reduce always IGNORED accumulate (runs exact, no error);
+    # arming a batching server must not make compute(handle,
+    # accumulate=...) start raising in unrelated user code
+    b = _bases(mesh, 1)[0]
+    eager = bolt.compute(b.map(ADD1).reduce(jnp.add), accumulate="bf16")
+    with serve.serving(workers=1, batching=True):
+        h = b.map(ADD1).reduce(jnp.add)
+        assert h._spending is not None            # the door is armed
+        deferred = bolt.compute(h, accumulate="bf16")
+        assert np.array_equal(np.asarray(deferred.toarray()),
+                              np.asarray(eager.toarray()))
+    serve.stop()
+
+
+def test_estimate_fast_path_matches_admission_floor(mesh):
+    # serve._estimate's chain-group fast path must agree with the
+    # analysis layer's admission floor — one source of truth for BLT010
+    from bolt_tpu.analysis import admission_floor_bytes
+    from bolt_tpu.serve import _estimate
+    b = _bases(mesh, 1)[0]
+    for arr in (b.map(ADD1).sum(), b.map(ADD1).var()):
+        assert _estimate(arr) == admission_floor_bytes(arr)
+
+
+def test_warm_dispatches_not_counted_as_realised_coalescing(mesh):
+    b = _bases(mesh, 1)[0]
+
+    def make():
+        return b.map(ADD1).sum()
+
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.01}) as sv:
+        c0 = engine.counters()
+        batched.warm(make, buckets=sv.batching.buckets)
+        c1 = engine.counters()
+        assert c1["batched_dispatches"] == c0["batched_dispatches"]
+        assert c1["batched_requests"] == c0["batched_requests"]
+        # warm DID run the bucket programs (fresh compiles, or cache
+        # hits when an earlier test already built them)
+        assert (c1["hits"] + c1["misses"]) > (c0["hits"] + c0["misses"])
+        assert c1["dispatches"] > c0["dispatches"]
+
+
+def test_failed_constructor_does_not_leak_the_armed_door(mesh):
+    assert not batched.armed()
+    with pytest.raises(ValueError, match="weight"):
+        serve.Server(batching=True, weights={"a": 0})
+    # the failed construction must not leave the lazy-reduce door open
+    assert not batched.armed()
+    b = _bases(mesh, 1)[0]
+    assert b.map(ADD1).reduce(jnp.add)._spending is None
+
+
+def test_gather_width_capped_by_the_arbiter_budget(mesh):
+    # 4 queued same-key requests whose COMBINED batched footprint
+    # (members + stacked copy ~ 2x) exceeds the budget: the gather must
+    # cap the width so coalescing cannot bypass the arbitration that
+    # would have serialised them standalone
+    shape = (4096, 32)                     # 512 KB per request
+    bs = _bases(mesh, 4, shape=shape)
+    refs = [np.asarray(b.map(ADD1).sum().toarray()) for b in bs]
+    est = bs[0]._data.nbytes
+    budget = int(4.5 * est)                # fits 2 lanes + stack, not 4
+    with serve.serving(workers=1, budget_bytes=budget,
+                       batching={"max_batch": 4, "linger": 0.05}) as sv:
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        futs = [sv.submit(bs[i].map(ADD1).sum()) for i in range(4)]
+        gate.set()
+        outs = [np.asarray(f.result(timeout=120).toarray())
+                for f in futs]
+        blocker.result(timeout=30)
+        assert sv.stats()["arbiter"]["in_use_bytes"] == 0
+    for out, ref in zip(outs, refs):
+        assert np.array_equal(out, ref)
+    assert all((f.batch_width or 1) <= 2 for f in futs)
+
+
+def test_occupancy_counts_realised_dispatches_only(mesh, monkeypatch):
+    from bolt_tpu.obs import metrics as _metrics
+    h = _metrics.registry().histogram("serve.batch_occupancy.hist",
+                                      lo=0, hi=9)
+    h.reset()
+    bs = _bases(mesh, 3)
+
+    def boom(batch, buckets):
+        raise RuntimeError("injected")
+
+    monkeypatch.setattr(batched, "dispatch", boom)
+    with serve.serving(workers=1, batching={"max_batch": 4,
+                                            "linger": 0.05}) as sv:
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        futs = [sv.submit(bs[i].map(ADD1).sum()) for i in range(3)]
+        gate.set()
+        [f.result(timeout=120) for f in futs]
+        blocker.result(timeout=30)
+    # the gather degraded to standalone dispatches: NO occupancy sample
+    assert h.snapshot()["count"] == 0
+
+
+# ---------------------------------------------------------------------
+# Server.stop with queued-but-unstarted requests (ISSUE 13 satellite)
+# ---------------------------------------------------------------------
+
+def _park_and_queue(sv, mesh, batchable):
+    bs = _bases(mesh, 4)
+    gate = threading.Event()
+    blocker = sv.submit(lambda: gate.wait(10))
+    time.sleep(0.05)                   # the worker is inside the blocker
+    if batchable:
+        futs = [sv.submit(bs[i].map(ADD1).sum()) for i in range(4)]
+    else:
+        futs = [sv.submit(lambda i=i: i) for i in range(4)]
+    return gate, blocker, futs
+
+
+@pytest.mark.parametrize("batching", [None, {"max_batch": 4,
+                                             "linger": 0.01}])
+@pytest.mark.parametrize("batchable", [True, False])
+def test_stop_fails_queued_unstarted_futures_pointedly(
+        mesh, batching, batchable):
+    sv = serve.start(workers=1, batching=batching)
+    try:
+        gate, blocker, futs = _park_and_queue(sv, mesh, batchable)
+        releaser = threading.Thread(target=lambda: (time.sleep(0.2),
+                                                    gate.set()))
+        releaser.start()
+        t0 = time.perf_counter()
+        serve.stop(wait=False)
+        elapsed = time.perf_counter() - t0
+        releaser.join()
+        assert elapsed < 8.0                        # no hang
+        for f in futs:
+            with pytest.raises(RuntimeError,
+                               match="closed before this job ran"):
+                f.result(timeout=5)
+            assert f.done() and f.batch_width is None
+        # the parked job itself was already running: it completes or
+        # fails, but every queued-unstarted future failed pointedly and
+        # the arbiter holds nothing
+        assert sv.arbiter.in_use() == 0
+        assert sv.arbiter.waiting() == 0
+    finally:
+        if serve.active() is sv:
+            serve.stop(wait=False)
+
+
+def test_close_wait_true_drains_queued_batched_jobs(mesh):
+    bs = _bases(mesh, 3)
+    refs = [np.asarray(b.map(ADD1).sum().toarray()) for b in bs]
+    sv = serve.start(workers=1, batching={"max_batch": 4,
+                                          "linger": 0.01})
+    try:
+        gate = threading.Event()
+        blocker = sv.submit(lambda: gate.wait(10))
+        time.sleep(0.05)
+        futs = [sv.submit(bs[i].map(ADD1).sum()) for i in range(3)]
+        gate.set()
+        serve.stop(wait=True)          # drain: queued jobs RUN first
+        for f, ref in zip(futs, refs):
+            assert np.array_equal(np.asarray(
+                f.result(timeout=5).toarray()), ref)
+        assert blocker.done()
+    finally:
+        if serve.active() is sv:
+            serve.stop(wait=False)
+
+
+# ---------------------------------------------------------------------
+# span / arbiter hygiene
+# ---------------------------------------------------------------------
+
+def test_batched_serving_leaks_no_spans_or_bytes(mesh):
+    from bolt_tpu import obs
+    bs = _bases(mesh, 4)
+    obs.clear()
+    obs.enable()
+    try:
+        with serve.serving(workers=2, batching={"max_batch": 4,
+                                                "linger": 0.02}) as sv:
+            futs = [sv.submit(bs[i % 4].map(ADD1).sum(),
+                              tenant="t%d" % (i % 2)) for i in range(8)]
+            [f.result(timeout=120) for f in futs]
+            assert sv.stats()["arbiter"]["in_use_bytes"] == 0
+        assert obs.active_count() == 0
+        names = {s.name for s in obs.spans()}
+        assert "serve.batch" in names
+        assert "serve.batched_dispatch" in names
+    finally:
+        obs.disable()
+        obs.clear()
