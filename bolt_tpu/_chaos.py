@@ -53,6 +53,7 @@ _ARMED = False          # the one hot-path check
 # sweeps this list x {raise, kill} and asserts recovery or a pointed
 # error for each; adding a chaos.hit() call site means adding it here
 SEAMS = (
+    "stream.encode",          # codec slab encode on an uploader worker
     "stream.upload",          # uploader-pool / prefetch ingest hot path
     "stream.dispatch",        # consumer, before each slab dispatch
     "stream.fold",            # the final pairwise fold

@@ -172,3 +172,35 @@ def resolve_accumulate(explicit=None):
     if st:
         return st[-1]
     return None
+
+
+# ---------------------------------------------------------------------
+# codec-encoded ingest accuracy contract (bolt_tpu/tpu/codec.py,
+# ISSUE 14) — the third precision axis, same template as accumulate():
+# the default (no codec) is bit-exact; lossy codecs are an explicit
+# per-source/per-scope opt-in with the parity envelopes below, which
+# tests/test_codec.py locks streamed results against.  Order statistics
+# and integer pipelines refuse lossy codecs at the executor (quantised
+# min/max is never what the caller meant); the lossless "delta-f32"
+# codec is bit-identical by construction and accepted everywhere.
+# ---------------------------------------------------------------------
+
+# codec name -> (lossless, documented relative-error envelope vs the
+# uncompressed streamed result; None = bit-identical).  int8's envelope
+# is ABSOLUTE per element (~half the per-slab quantisation step,
+# value-range dependent) — tests derive the concrete bound from each
+# slab's range, like the int8-accumulate wraparound contract.
+CODEC_BOUNDS = {
+    "bf16": (False, 1e-2),
+    "f16": (False, 1e-3),
+    "int8": (False, "~scale/2 absolute (scale = slab range / 255)"),
+    "delta-f32": (True, None),
+}
+
+
+def codec_bound(name):
+    """``(lossless, envelope)`` for a registered codec name — the
+    documented parity contract the codec suite asserts.  Unknown names
+    return ``(False, None)`` (a custom registered codec documents its
+    own bound)."""
+    return CODEC_BOUNDS.get(name, (False, None))

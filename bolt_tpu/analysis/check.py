@@ -139,8 +139,38 @@ def _group_bytes(g):
 # admission budget (the serving layer's BLT010 contract)
 # ---------------------------------------------------------------------
 
+def _effective_codec(src):
+    """The codec a run over ``src`` would resolve (source ``codec=``
+    wins over the caller's ``stream.codec()`` scope), WITHOUT the dtype
+    validation ``stream.resolve_codec`` performs — the checker wants to
+    FORECAST the refusal (BLT016 warning), not raise it.  Unknown names
+    cannot arm through any public door (``fromcallback``/``fromiter``,
+    the scope and ``set_codec`` all validate pointedly), but a
+    hand-built source must degrade to "no forecast", never crash the
+    checker — the run itself still refuses at ``resolve_codec``."""
+    from bolt_tpu import stream as _stream
+    name = src.codec if src.codec is not None else _stream.current_codec()
+    if name is None:
+        return None
+    from bolt_tpu.tpu import codec as _codeclib
+    try:
+        return _codeclib.get(name)
+    except ValueError:
+        return None
+
+
 def _stream_slab_bytes(src):
-    return int(src.slab * prod(src.shape[1:]) * src.dtype.itemsize)
+    """One slab's DEVICE bytes — the WIRE representation when a codec
+    is armed (the ring holds and the arbiter leases compressed slabs;
+    the admission floor recomputes through the codec ratio)."""
+    itemsize = src.dtype.itemsize
+    c = _effective_codec(src)
+    if c is not None:
+        try:
+            itemsize = c.wire_dtype(src.dtype).itemsize
+        except ValueError:
+            pass          # refused combination: the run never streams
+    return int(src.slab * prod(src.shape[1:]) * itemsize)
 
 
 def _stream_ring_bytes(src):
@@ -331,6 +361,9 @@ def _check_spending(arr, target, stages, diags):
     _note_batchable(arr, 1, diags)
     _note_admission(_stream_slab_bytes(g.source) if g.kind == "stream"
                     else _group_bytes(g), 1, diags)
+    if g.kind == "stream":
+        _note_codec(g.source, 1, diags,
+                    members=[m.name for m in g.members])
     return Report(target + ", pending stat", stages, diags)
 
 
@@ -349,6 +382,55 @@ def _note_fusable_group(g, idx, diags):
            _fmt_bytes(nbytes * len(pend))),
         hint="read any member (or bolt.compute(...)) to dispatch the "
              "group; terminals on other sources fall back per group"))
+
+
+def _note_codec(src, idx, diags, members=()):
+    """``BLT016``: forecast codec-encoded ingest (ISSUE 14) — the bytes
+    this streaming plan will NOT move over the host→device link, plus a
+    WARNING when a lossy codec meets a bit-exactness-sensitive terminal
+    (order statistics — the executor will refuse) or a dtype the codec
+    cannot encode."""
+    c = _effective_codec(src)
+    if c is None:
+        return
+    raw = int(prod(src.shape) * src.dtype.itemsize)
+    try:
+        wire = int(prod(src.shape) * c.wire_dtype(src.dtype).itemsize)
+    except ValueError as exc:
+        diags.append(Diagnostic(
+            "BLT016", idx,
+            "codec %r cannot encode this %s pipeline — the streamed "
+            "run will refuse pointedly: %s"
+            % (c.name, np.dtype(src.dtype), str(exc).splitlines()[0]),
+            severity="warning",
+            hint="pick a codec that supports the dtype, or stream "
+                 "uncompressed"))
+        return
+    sensitive = sorted({m for m in members if m in ("min", "max",
+                                                    "ptp")})
+    if not c.lossless and sensitive:
+        diags.append(Diagnostic(
+            "BLT016", idx,
+            "lossy codec %r meets the bit-exactness-sensitive order "
+            "statistic(s) %s — the streamed run will refuse them "
+            "(a quantised extremum is never the intended answer)"
+            % (c.name, sensitive), severity="warning",
+            hint="use the lossless 'delta-f32' codec for order stats, "
+                 "or resolve them over an uncompressed source"))
+        return
+    diags.append(Diagnostic(
+        "BLT016", idx,
+        "codec-encoded ingest (%s%s): one full pass ships ~%s on the "
+        "wire instead of ~%s (%.2fx)%s"
+        % (c.name, "" if c.lossless else ", LOSSY opt-in",
+           _fmt_bytes(wire), _fmt_bytes(raw),
+           (wire / raw) if raw else 1.0,
+           " — lossless: bit-identical to uncompressed streaming"
+           if c.lossless else ""),
+        hint="uploader workers encode per slab (codec_bytes_raw/"
+             "codec_bytes_wire engine counters); the slab program "
+             "decodes on device fused into the fold — zero extra HBM "
+             "passes, and the arbiter leases the wire bytes"))
 
 
 def _check_predicate(pred, vshape, vdtype, idx, diags):
@@ -821,6 +903,7 @@ def _check_stream(arr, target, stages, diags):
                      "of the key-axis device assignment; uneven tails "
                      "cannot stream across processes"))
     _note_admission(_stream_slab_bytes(src), 0, diags)
+    _note_codec(src, 0, diags)
     _note_resumable(src, 0, diags)
     _note_pod_recovery(src, nproc, 0, diags)
     _note_supervised_source(src, nproc, 0, diags)

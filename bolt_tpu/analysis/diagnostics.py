@@ -44,6 +44,9 @@ CODES = {
     "BLT015": ("info",
                "terminal is batch-eligible: a batching server coalesces "
                "same-key requests into one dispatch"),
+    "BLT016": ("info",
+               "codec-encoded ingest: streamed slabs ship compressed "
+               "and decode on device"),
 }
 
 SEVERITIES = ("error", "warning", "info")

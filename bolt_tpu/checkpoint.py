@@ -265,7 +265,8 @@ def _state_digest(slabs, records, leaves):
 
 
 def stream_save(path, fingerprint, slabs, records, state,
-                multiprocess=None, rendezvous=True, remap_from=None):
+                multiprocess=None, rendezvous=True, remap_from=None,
+                codec=None):
     """Persist one streamed-run checkpoint: ``slabs`` retired slabs
     covering ``records`` records, with ``state`` the executor's folded
     partial accumulator (``(levels, pend)`` — device values are pulled
@@ -300,7 +301,12 @@ def stream_save(path, fingerprint, slabs, records, state,
     ``remap_from`` records a topology remap in the meta (the resumed
     run's first checkpoint after a shrink names the pod width the
     loaded checkpoint was cut by) — the audit trail that makes a
-    3→2-process resume explainable from the directory alone."""
+    3→2-process resume explainable from the directory alone.
+    ``codec`` records the run's ingest codec id the same way (ISSUE
+    14): the MATCHING lives in the fingerprint — a codec change names
+    a different logical run and the checkpoint is ignored — but the
+    meta row makes "this resume point was cut under int8" readable
+    from the directory."""
     _chaos.hit("stream.checkpoint")
     os.makedirs(path, exist_ok=True)
     if multiprocess is None:
@@ -345,6 +351,8 @@ def stream_save(path, fingerprint, slabs, records, state,
             "leaves": len(leaves), "nproc": nproc}
     if remap_from is not None:
         meta["remapped_from"] = int(remap_from)
+    if codec is not None:
+        meta["codec"] = str(codec)
     if nproc > 1 and not rendezvous:
         meta["abort"] = True
         # advance-only: survivors may abort at different watermarks and

@@ -159,6 +159,15 @@ _SCHEMA = {
     # "serve.batch_occupancy.hist"
     "batched_dispatches": 0,      # coalesced batched program dispatches
     "batched_requests": 0,        # requests served BY those dispatches
+    # codec-encoded streaming ingest (bolt_tpu/tpu/codec.py, ISSUE 14):
+    # uploader workers ENCODE slabs on host before shipping, the slab
+    # program decodes on device fused into the fold.  raw - wire =
+    # host->device bytes SAVED; transfer_bytes tallies the wire bytes
+    # (what actually crossed the link).
+    "codec_encode_seconds": 0.0,  # host wall inside slab encodes
+                                  # (summed across uploader workers)
+    "codec_bytes_raw": 0,         # pre-encode logical slab bytes
+    "codec_bytes_wire": 0,        # post-encode bytes actually shipped
 }
 
 _COUNTERS = _metrics.registry().group("engine", _SCHEMA)
@@ -509,6 +518,17 @@ def record_transfer(nbytes, seconds):
     _COUNTERS.update(transfer_bytes=int(nbytes),
                      transfer_seconds=seconds)
     _TRANSFER_HIST.observe(int(nbytes))
+
+
+def record_codec(raw_bytes, wire_bytes, seconds):
+    """Tally one slab encode (bolt_tpu.stream's uploader workers — the
+    codec-encoded ingest path, bolt_tpu/tpu/codec.py).  Applied
+    atomically so a snapshot can never see a slab's raw bytes without
+    its wire bytes; the timeline carries it as the ``stream.encode``
+    span."""
+    _COUNTERS.update(codec_bytes_raw=int(raw_bytes),
+                     codec_bytes_wire=int(wire_bytes),
+                     codec_encode_seconds=seconds)
 
 
 def record_stream_retry():

@@ -96,7 +96,8 @@ def rand(shape, context=None, axis=(0,), mode=None, dtype=None, seed=0):
 
 
 def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None,
-                 chunks=None, checkpoint=None, per_process=False):
+                 chunks=None, checkpoint=None, per_process=False,
+                 codec=None):
     """Build a bolt array by calling ``fn(index_slices) -> block`` per
     index range — the sharded data-loader (extension beyond the reference
     factory, whose ``sc.parallelize`` scatter needs the full array at the
@@ -109,7 +110,10 @@ def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None,
     ``stream.resumable``); ``per_process=True`` opts a MULTI-PROCESS
     mesh into the pod-scale streaming contract (each host's loader is
     invoked only for its own shard of each slab; the cross-host fold
-    runs as mesh collectives — ``bolt_tpu.parallel.multihost``).
+    runs as mesh collectives — ``bolt_tpu.parallel.multihost``);
+    ``codec=`` names an ingest codec (``bolt_tpu.tpu.codec``) so
+    streamed slabs ship ENCODED and decode on device — fewer
+    host→device bytes on the transfer-bound path.
     Local mode: one call for the whole array."""
     cls = _lookup(context=context, mode=mode)
     if cls is ConstructLocal:
@@ -117,24 +121,26 @@ def fromcallback(fn, shape, context=None, axis=(0,), mode=None, dtype=None,
     return ConstructTPU.fromcallback(fn, shape, context=context, axis=axis,
                                      dtype=dtype, chunks=chunks,
                                      checkpoint=checkpoint,
-                                     per_process=per_process)
+                                     per_process=per_process, codec=codec)
 
 
 def fromiter(blocks, shape, context=None, axis=(0,), mode=None, dtype=None,
-             checkpoint=None):
+             checkpoint=None, codec=None):
     """Build a bolt array from an ITERABLE of consecutive record blocks
     (key-axes-first layout along the first key axis) — the sequential
     streaming constructor for sources without random access.  ``dtype``
     is required.  ``mode='tpu'``: a lazy streaming source like
     :func:`fromcallback` (``checkpoint=dir`` arms slab-level resume —
     meaningful only for RE-ITERABLE block sources; a one-shot generator
-    dies with the process, which ``analysis.check`` flags as BLT011);
+    dies with the process, which ``analysis.check`` flags as BLT011;
+    ``codec=`` arms codec-encoded ingest like :func:`fromcallback`'s);
     local mode assembles the blocks on host."""
     cls = _lookup(context=context, mode=mode)
     if cls is ConstructLocal:
         return ConstructLocal.fromiter(blocks, shape, axis=axis, dtype=dtype)
     return ConstructTPU.fromiter(blocks, shape, context=context, axis=axis,
-                                 dtype=dtype, checkpoint=checkpoint)
+                                 dtype=dtype, checkpoint=checkpoint,
+                                 codec=codec)
 
 
 def concatenate(arrays, axis=0, context=None, mode=None):

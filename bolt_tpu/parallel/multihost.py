@@ -448,3 +448,28 @@ def slab_divisibility_error(mesh, shape, split, ranges):
                     "multi-process mesh)"
                     % (lo, hi, hi - lo, width, full_axes, width))
     return None
+
+
+def sidecar_codec_error(codec, mesh):
+    """The pod-scale codec rule, as one shared message (``stream``
+    raises it; ``analysis.check`` forecasts it under BLT016): a codec
+    whose encode emits a per-slab SIDECAR (int8's scale/zero point)
+    cannot run on a multi-process mesh — each process encodes only its
+    LOCAL shard, so the sidecars are per-process values, not the
+    replicated globals a ``shard_map`` slab program's inputs must be
+    (and gluing them in would re-introduce the cross-host bytes the
+    codec exists to remove).  Sidecar-FREE codecs (``bf16``/``f16``/
+    ``delta-f32``) stream on pods unchanged: every process encodes its
+    own shard, so DCN/gloo ingest bytes shrink by the same wire ratio.
+    Returns the message string, or ``None`` when the combination is
+    fine."""
+    if codec is None or not getattr(codec, "sidecar", False) \
+            or mesh_process_count(mesh) <= 1:
+        return None
+    return ("codec %r carries a per-slab sidecar and cannot stream on "
+            "a mesh spanning %d processes: per-process encodes produce "
+            "per-process sidecars, which are not the replicated global "
+            "inputs a shard_map slab program requires.  Use a "
+            "sidecar-free codec ('bf16', 'f16', 'delta-f32') on pods, "
+            "or stream this source uncompressed"
+            % (codec.name, mesh_process_count(mesh)))

@@ -173,13 +173,22 @@ class BatchPolicy:
     * ``buckets`` — the compiled batch widths (default powers of two up
       to ``max_batch``): partial batches PAD to the next bucket, so
       steady state compiles a small fixed executable set and then runs
-      zero fresh XLA compiles.
+      zero fresh XLA compiles;
+    * ``autotune`` — the width-autotuning scaffold (off by default):
+      when True, :meth:`rearm` (called by ``batched.warm(make,
+      policy=...)`` on a re-arm) re-derives the bucket set from the
+      OBSERVED ``serve.batch_occupancy.hist`` distribution
+      (``batched.autotune_buckets``), so the compiled widths track the
+      occupancy mix traffic actually realises.  With autotune off the
+      static knobs are untouched — today's behaviour exactly.
     """
 
-    __slots__ = ("max_batch", "linger", "buckets")
+    __slots__ = ("max_batch", "linger", "buckets", "autotune")
 
-    def __init__(self, max_batch=None, linger=None, buckets=None):
+    def __init__(self, max_batch=None, linger=None, buckets=None,
+                 autotune=False):
         from bolt_tpu.tpu import batched as _batched
+        self.autotune = bool(autotune)
         if buckets:
             buckets = tuple(sorted(int(b) for b in buckets))
             if buckets[0] < 2:
@@ -205,9 +214,32 @@ class BatchPolicy:
                 "would pad every dispatch past the promised widest "
                 "width" % (self.buckets[-1], self.max_batch))
 
+    def rearm(self, hist_buckets=None):
+        """Autotune re-arm: replace :attr:`buckets` with the set
+        :func:`bolt_tpu.tpu.batched.autotune_buckets` derives from the
+        observed ``serve.batch_occupancy.hist`` (``hist_buckets``
+        overrides the registry read, for tests).  Returns True when
+        the buckets changed hands; a no-op False when ``autotune`` is
+        off (static knobs untouched) or nothing has been observed yet.
+        The derived set always ends at ``max_batch``, preserving the
+        policy invariant."""
+        if not self.autotune:
+            return False
+        from bolt_tpu.tpu import batched as _batched
+        if hist_buckets is None:
+            from bolt_tpu.obs import metrics as _metrics
+            hist_buckets = _metrics.registry().histogram(
+                "serve.batch_occupancy.hist", lo=0, hi=9).buckets()
+        derived = _batched.autotune_buckets(hist_buckets, self.max_batch)
+        if derived is None:
+            return False
+        self.buckets = derived
+        return True
+
     def __repr__(self):
-        return ("BatchPolicy(max_batch=%d, linger=%g, buckets=%s)"
-                % (self.max_batch, self.linger, self.buckets))
+        return ("BatchPolicy(max_batch=%d, linger=%g, buckets=%s%s)"
+                % (self.max_batch, self.linger, self.buckets,
+                   ", autotune" if self.autotune else ""))
 
 
 # ---------------------------------------------------------------------
@@ -523,7 +555,11 @@ def _estimate(arr):
     """The MINIMUM device working set of a bolt-array pipeline (the
     BLT010 admission floor: one slab for streams — the arbiter degrades
     the ring; base + result for in-memory pipelines).  None when
-    nothing could be estimated (callables, local arrays)."""
+    nothing could be estimated (callables, local arrays).  Streaming
+    plans under an ingest codec (ISSUE 14) estimate — and the executor
+    leases — the COMPRESSED slab bytes: ``admission_floor_bytes``
+    applies the codec's wire ratio, so a bf16-encoded tenant is
+    admitted at half the budget footprint its raw twin would claim."""
     try:
         h = getattr(arr, "_spending", None)
         if h is not None and h.group.kind == "chain":
@@ -825,6 +861,22 @@ class Server:
                 raise ValueError("deadline must be positive seconds "
                                  "since submit, got %r" % (deadline,))
         job, arr = _normalise(pipeline)
+        # the SUBMITTER's effective ingest codec rides into the worker
+        # (ISSUE 14): stream scopes are thread-local, so a tenant's
+        # `with stream.codec("bf16"): submit(...)` would otherwise be
+        # silently dropped on the worker thread — while the admission
+        # floor below, computed HERE, already priced the wire bytes.
+        # current_codec() collapses scope + process default into one
+        # name, so re-entering it on the worker preserves exactly the
+        # semantics the submitter saw (a per-source codec= still wins).
+        from bolt_tpu import stream as _streamlib
+        cname = _streamlib.current_codec()
+        if cname is not None:
+            inner = job
+
+            def job():
+                with _streamlib.codec(cname):
+                    return inner()
         est = _estimate(arr) if arr is not None else None
         if est is not None and est > self.arbiter.budget:
             # BLT010: could NEVER run — admitting it would wedge a

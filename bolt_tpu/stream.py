@@ -286,6 +286,85 @@ def retries(n):
         st.pop()
 
 
+# codec-encoded ingest (ISSUE 14, bolt_tpu/tpu/codec.py): the process
+# default codec NAME the thread-local codec() scopes override; None =
+# uncompressed.  Lazily validated against the registry so merely
+# importing stream never touches the codec module.
+_CODEC = os.environ.get("BOLT_STREAM_CODEC") or None
+
+
+def _codec_registry():
+    from bolt_tpu.tpu import codec as m
+    return m
+
+
+def current_codec():
+    """The calling thread's effective codec NAME (innermost
+    :func:`codec` scope, else the process default; ``None`` =
+    uncompressed).  A source's own ``codec=`` always wins over this —
+    see :func:`resolve_codec`."""
+    st = _scope_stack("codec")
+    if st:
+        return st[-1]
+    return _CODEC
+
+
+def set_codec(name):
+    """Set the process-wide DEFAULT ingest codec (``None`` restores
+    uncompressed; ``BOLT_STREAM_CODEC`` seeds it); per-thread
+    :func:`codec` scopes override it."""
+    global _CODEC
+    if name is not None:
+        _codec_registry().get(name)     # pointed unknown-codec error NOW
+    _CODEC = name
+
+
+@contextlib.contextmanager
+def codec(name):
+    """Scope codec-encoded ingest for streamed runs::
+
+        with bolt_tpu.stream.codec("bf16"):
+            src.map(f).sum()     # slabs ship at half the bytes; the
+                                 # slab program decodes on device
+
+    ``codec(None)`` restores uncompressed ingest inside the scope.
+    THREAD-LOCAL with the same stack discipline as :func:`uploaders` /
+    :func:`prefetch`: one serve tenant's lossy opt-in must never
+    silently quantise a neighbour's stream — and ``serve.submit``
+    captures the SUBMITTER's effective codec and re-enters it on the
+    worker thread, so a scope wrapped around a submit is honoured by
+    the job (and priced by admission) rather than dropped at the
+    thread boundary.  A per-source
+    ``fromcallback(..., codec=)`` / ``fromiter(..., codec=)`` takes
+    precedence over the scope (mirroring ``checkpoint=``).  The
+    accuracy contract lives with the registry
+    (:mod:`bolt_tpu.tpu.codec`): lossless ``"delta-f32"`` is
+    bit-identical to uncompressed streaming; lossy codecs are refused
+    for order-statistic terminals and non-float pipelines."""
+    if name is not None:
+        _codec_registry().get(name)     # validate at scope entry
+    st = _scope_stack("codec")
+    st.append(name)
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def resolve_codec(source):
+    """The effective :class:`~bolt_tpu.tpu.codec.Codec` for a run over
+    ``source`` — the source's own ``codec=`` wins over the calling
+    thread's scope/default; ``None`` = uncompressed.  Validates the
+    codec against the source dtype (the pointed integer/bool-pipeline
+    refusal lives in ``Codec.wire_dtype``)."""
+    name = source.codec if source.codec is not None else current_codec()
+    if name is None:
+        return None
+    c = _codec_registry().get(name)
+    c.wire_dtype(source.dtype)
+    return c
+
+
 def checkpoint_scope():
     """The calling thread's innermost :func:`resumable` scope as
     ``(dir, every)``, or ``None`` when streaming is not resumable."""
@@ -446,6 +525,28 @@ def _upload_slab_mh(block, mesh, split, slab_shape, axis0_off):
     return out
 
 
+def _encode_slab(codec_obj, block, delta_ok):
+    """Host-side slab ENCODE on an uploader worker (ISSUE 14): the
+    ``stream.encode`` chaos seam and obs span (``bytes_raw`` /
+    ``bytes_wire`` attrs, nesting under the worker's ``stream.ingest``
+    span) plus the ``codec_*`` engine counters all live here.  Encode
+    runs per worker, so N workers encode N slabs concurrently — the
+    encode cost rides inside the already-overlapped ingest phase."""
+    _chaos.hit("stream.encode")
+    sp = _obs.begin("stream.encode", codec=codec_obj.name)
+    t0 = _clock()
+    try:
+        wire, side = codec_obj.encode(block, delta_ok)
+        _engine.record_codec(int(block.nbytes), int(wire.nbytes),
+                            _clock() - t0)
+        if sp is not None:
+            sp.set(bytes_raw=int(block.nbytes),
+                   bytes_wire=int(wire.nbytes))
+    finally:
+        _obs.end(sp)
+    return wire, side
+
+
 # ---------------------------------------------------------------------
 # the lazy source
 # ---------------------------------------------------------------------
@@ -466,10 +567,11 @@ class StreamSource:
     fold without ever materialising a compaction buffer."""
 
     __slots__ = ("kind", "produce", "blocks", "shape", "split", "dtype",
-                 "mesh", "slab", "stages", "ckpt", "_state", "_consumed")
+                 "mesh", "slab", "stages", "ckpt", "codec", "_state",
+                 "_consumed")
 
     def __init__(self, kind, produce, blocks, shape, split, dtype, mesh,
-                 slab, stages=(), ckpt=None):
+                 slab, stages=(), ckpt=None, codec=None):
         self.kind = kind
         self.produce = produce          # callback: fn(index_slices)
         self.blocks = blocks            # iter: the iterable of blocks
@@ -480,6 +582,8 @@ class StreamSource:
         self.slab = int(slab)
         self.stages = tuple(stages)
         self.ckpt = ckpt                # resumable checkpoint dir (or None)
+        self.codec = codec              # ingest codec NAME (or None);
+        #                                 wins over the codec() scope
         self._state = None
         # iter sources stream ONCE per iter() of a one-shot iterable (a
         # generator cannot rewind); the cell is SHARED across derived
@@ -490,26 +594,34 @@ class StreamSource:
 
     @classmethod
     def from_callback(cls, fn, shape, split, dtype, mesh, chunks=None,
-                      checkpoint=None):
+                      checkpoint=None, codec=None):
+        if codec is not None:
+            # a typo'd codec name must be a pointed error HERE, at the
+            # construction boundary — not a crash inside the checker or
+            # a first-terminal surprise (dtype fit still resolves per
+            # run: the scope form can override a None source codec)
+            _codec_registry().get(codec)
         slab = _slab_records(shape, dtype, chunks)
         return cls("callback", fn, None, shape, split, dtype, mesh, slab,
-                   ckpt=checkpoint)
+                   ckpt=checkpoint, codec=codec)
 
     @classmethod
     def from_iter(cls, blocks, shape, split, dtype, mesh,
-                  checkpoint=None):
+                  checkpoint=None, codec=None):
+        if codec is not None:
+            _codec_registry().get(codec)    # pointed at construction
         # slab sizes are whatever the iterator yields; the recorded slab
         # is only the default the shape/dtype imply (for repr/reports)
         slab = _slab_records(shape, dtype, None)
         return cls("iter", None, blocks, shape, split, dtype, mesh, slab,
-                   ckpt=checkpoint)
+                   ckpt=checkpoint, codec=codec)
 
     def with_stage(self, stage):
         """A new source sharing the host side, one device stage longer."""
         out = StreamSource(self.kind, self.produce, self.blocks,
                            self.shape, self.split, self.dtype, self.mesh,
                            self.slab, self.stages + (stage,),
-                           ckpt=self.ckpt)
+                           ckpt=self.ckpt, codec=self.codec)
         out._consumed = self._consumed      # same iterator, same budget
         return out
 
@@ -942,7 +1054,7 @@ def _terminal_partial(terminal, flat, mask, mfull, vshape, n, rfunc,
 
 
 def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
-                  comps=None, sharded=False):
+                  comps=None, sharded=False, codec_obj=None):
     """The ONE compiled program each slab runs: device-side stages +
     (masked) terminal partial, with the slab buffer DONATED so the ring
     recycles its memory.  ``fused=True`` is the level-0 fold fusion: the
@@ -954,6 +1066,18 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
     multi-stat layer (bolt_tpu/tpu/multistat.py); each component traces
     the exact standalone expression via :func:`_terminal_partial`.
 
+    ``codec_obj`` (ISSUE 14) is the ingest codec whose device-side
+    DECODE is fused in as the program's FIRST traced expression: the
+    uploaded buffer is the wire representation (plus sidecar leaves for
+    sidecar codecs — the whole pytree is donated like the raw slab
+    was), and the decoded values feed the exact same stage chain and
+    terminal partial the uncompressed program traces — decode costs
+    zero extra HBM passes.  With ``BOLT_CODEC_KERNEL=1`` an int8
+    streamed ``sum`` with no stages routes through the Pallas
+    decode-and-reduce kernel (``ops.kernels.fused_decode_sum``,
+    geometry-gated, parity-locked) so the decode never leaves
+    registers.
+
     ``sharded=True`` is the POD form (``parallel.multihost``): the same
     partial body runs under ``shard_map`` — each device computes its
     shard's partial and the reduction points carry the cross-host
@@ -961,8 +1085,10 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
     program's output is the ALREADY-GLOBAL pair partial, replicated on
     every process (``out_specs=P()``).  The level-0 acc merge stays an
     elementwise combine on replicated values outside the shard_map —
-    no extra collective.  Engine-cached per (stages, terminal, slab
-    geometry, fused, comps, process topology): uniform slabs compile
+    no extra collective; codec decode happens per shard INSIDE the
+    shard_map (sidecar codecs are refused on pods before any thread
+    starts).  Engine-cached per (stages, terminal, slab geometry,
+    fused, comps, codec, process topology): uniform slabs compile
     exactly once per variant PER PROCESS."""
     stages = source.stages
     pred = None
@@ -971,10 +1097,18 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
         stages = stages[:-1]
     split = source.split
     mesh = source.mesh
+    raw_dtype = source.dtype
+    delta_ok = split < len(source.shape)
+    use_kernel = (codec_obj is not None and codec_obj.name == "int8"
+                  and terminal == "sum" and not stages and pred is None
+                  and not sharded and split == 1
+                  and _codec_registry().kernel_enabled())
     key = ("stream-slab-acc" if fused else "stream-slab", terminal,
            stages, pred, slab_shape, str(source.dtype), split, ddof,
            rfunc, comps, mesh,
-           _multihost.topology_token() if sharded else None)
+           _multihost.topology_token() if sharded else None,
+           codec_obj.name if codec_obj is not None else None,
+           use_kernel)
 
     def build():
         axes = _multihost.key_collective_axes(mesh, slab_shape, split) \
@@ -985,7 +1119,24 @@ def _slab_program(source, terminal, slab_shape, ddof, rfunc, fused=False,
             # is the whole slab — the body is shape-polymorphic and the
             # collective points in _terminal_partial close the gap
             from bolt_tpu.tpu.array import _pred_mask
-            x = data
+            if codec_obj is None:
+                x = data
+            else:
+                if use_kernel:
+                    # the opt-in in-register decode-and-reduce: plan
+                    # resolution is static (shapes), so this branch is
+                    # decided at trace time; off-plan geometries fall
+                    # through to the XLA decode below
+                    from bolt_tpu.ops.kernels import fused_decode_sum
+                    out = fused_decode_sum(data[0], data[1], data[2])
+                    if out is not None:
+                        s = out.astype(raw_dtype)
+                        return jax.lax.psum(s, axes) if axes else s
+                if codec_obj.sidecar:
+                    x = codec_obj.decode(data[0], data[1:], raw_dtype,
+                                         delta_ok)
+                else:
+                    x = codec_obj.decode(data, (), raw_dtype, delta_ok)
             for stg in stages:
                 x = _stage_apply(stg, split, x)
             vshape = x.shape[split:]
@@ -1166,25 +1317,29 @@ def _stage_token(stage):
                     for x in stage)
 
 
-def _run_fingerprint(source, terminal, ddof, rfunc, specs):
+def _run_fingerprint(source, terminal, ddof, rfunc, specs, codec=None):
     """Identity of one LOGICAL streamed run for checkpoint matching:
-    source geometry + slab plan + stage chain + terminal, with every
-    user callable (stage funcs, the filter predicate, ``rfunc``, a
-    callback source's ``produce``) identified by its bytecode digest —
-    an EDITED pipeline over the same dir is refused, never resumed
-    wrong.  Closure DATA is not hashable (no checkpoint format's is):
-    re-pointing an identical loader at different bytes of the same
-    geometry is the caller's contract, as with any resume system."""
+    source geometry + slab plan + stage chain + terminal + ingest
+    CODEC, with every user callable (stage funcs, the filter predicate,
+    ``rfunc``, a callback source's ``produce``) identified by its
+    bytecode digest — an EDITED pipeline over the same dir is refused,
+    never resumed wrong, and a resumed run never adopts a checkpoint
+    cut under a DIFFERENT codec (the fold partials are decoded values;
+    mixing an uncompressed prefix with a quantised tail would be
+    silently wrong, so a codec change restarts from scratch).  Closure
+    DATA is not hashable (no checkpoint format's is): re-pointing an
+    identical loader at different bytes of the same geometry is the
+    caller's contract, as with any resume system."""
     from bolt_tpu.utils import code_token
     stages = "|".join(_stage_token(s) for s in source.stages)
     members = "|".join("%s:%s" % (n, d) for n, d in specs) if specs else ""
-    return ("bolt-stream-ckpt-v1", str(terminal), str(ddof),
+    return ("bolt-stream-ckpt-v2", str(terminal), str(ddof),
             code_token(rfunc) if rfunc is not None else "",
             "x".join(str(s) for s in source.shape),
             int(source.split), str(source.dtype), int(source.slab),
             str(source.kind),
             code_token(source.produce) if source.produce is not None
-            else "", stages, members)
+            else "", stages, members, str(codec or ""))
 
 
 # ---------------------------------------------------------------------
@@ -1410,6 +1565,28 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     split = source.split
     depth = prefetch_depth()
     nwork = pool_size(source)
+    # codec-encoded ingest (ISSUE 14): resolved ONCE per run (scopes
+    # are per-thread; the source's own codec= wins), validated against
+    # the dtype (integer/bool pipelines refuse lossy codecs pointedly
+    # in Codec.wire_dtype) and against the terminal: order statistics
+    # are bit-exactness-sensitive, so lossy codecs refuse them.
+    codec_obj = resolve_codec(source)
+    if codec_obj is not None and not codec_obj.lossless:
+        order = terminal in ("min", "max") or (
+            terminal == "multi"
+            and any(c in ("min", "max") for c in comps))
+        if order:
+            names = [n for n, _ in specs] if specs else [terminal]
+            raise ValueError(
+                "lossy codec %r refused for the order-statistic "
+                "terminal(s) %s: min/max/ptp are exact by contract and "
+                "a quantised extremum is never the answer the caller "
+                "meant.  Use the lossless 'delta-f32' codec, or stream "
+                "this terminal uncompressed" % (codec_obj.name, names))
+    delta_ok = split < len(source.shape)
+    wire_rec_bytes = prod(source.shape[1:]) * (
+        codec_obj.wire_dtype(source.dtype).itemsize
+        if codec_obj is not None else source.dtype.itemsize)
     # POD-SCALE run (parallel.multihost): the mesh spans processes, so
     # this executor instance is one of N peers running the SAME slab
     # schedule — each process produces and uploads only its own shard
@@ -1426,6 +1603,10 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
             source.slab_ranges() if source.kind == "callback" else [])
         if err is not None:
             raise ValueError(err)       # BLT012 — check() forecasts it
+        err = _multihost.sidecar_codec_error(codec_obj, mesh)
+        if err is not None:
+            raise ValueError(err)       # per-process sidecars cannot
+            #                             feed a shard_map slab program
         mspec = _multihost.local_slab_spec(source)
     # multi-tenant serving (bolt_tpu.serve): the run charges its slab
     # bytes to the process-wide device-memory arbiter — the ring's local
@@ -1436,7 +1617,11 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     tenant_tag = _engine.current_tenant()
     lease = _tenant_lease()
     nretry = retry_limit()          # resolved HERE: scopes are per-thread
-    rec_bytes = prod(source.shape[1:]) * source.dtype.itemsize
+    # the arbiter leases COMPRESSED slab bytes: what actually occupies
+    # the ring and crossed the link is the WIRE representation, so a
+    # codec-encoded tenant's admission floor shrinks by the wire ratio
+    # (analysis.admission_floor_bytes applies the same ratio)
+    rec_bytes = wire_rec_bytes
     # resumable checkpointing (ISSUE 9): a per-source checkpoint dir
     # (fromcallback/fromiter checkpoint=) wins over the thread's
     # resumable() scope.  A matching checkpoint from a killed run is
@@ -1476,7 +1661,9 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                 "checkpoint=/resumable() for this sub-mesh run)"
                 % (_multihost.mesh_process_count(mesh),
                    _multihost.process_count()))
-        ck_fp = _run_fingerprint(source, terminal, ddof, rfunc, specs)
+        ck_fp = _run_fingerprint(
+            source, terminal, ddof, rfunc, specs,
+            codec=codec_obj.name if codec_obj is not None else None)
         # the MESH's multiprocess answer, not the runtime's: a
         # process-local mesh inside a multi-process runtime checkpoints
         # single-process (its peers are elsewhere; a barrier would hang)
@@ -1530,9 +1717,37 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     # the exported timeline then shows ingest slabs under the run that
     # caused them, overlapping the main thread's compute slabs
     run_sp = _obs.begin("stream.run", terminal=terminal, depth=depth,
-                        uploaders=nwork, kind=source.kind)
+                        uploaders=nwork, kind=source.kind,
+                        **({"codec": codec_obj.name}
+                           if codec_obj is not None else {}))
 
     jobq = queue.Queue()
+
+    def _encode_upload(block, slab_shape, axis0_off):
+        """Encode (when a codec is armed) + upload ONE host block;
+        returns ``(buf, wire_nbytes)``.  ``buf`` is the bare sharded
+        wire/raw array, or — for sidecar codecs — a ``(wire, *sidecar)``
+        tuple whose every leaf the slab program donates.  The wire
+        block keeps the raw block's SHAPE (codecs change only the
+        dtype), so the per-device placement math is untouched."""
+        side = ()
+        if codec_obj is None:
+            payload = block
+        else:
+            payload, side = _encode_slab(codec_obj, block, delta_ok)
+        if mspec is None:
+            # through the module-level name so the single-process
+            # upload seam stays patchable (the fault/ordering tests'
+            # contract)
+            buf = _upload_slab(payload, mesh, split)
+        else:
+            buf = _upload_slab_mh(payload, mesh, split, slab_shape,
+                                  axis0_off)
+        if side:
+            # tiny per-slab sidecar (int8's scale/zero point): counted
+            # honest through the ONE transfer door like everything else
+            buf = (buf,) + tuple(transfer(np.asarray(s)) for s in side)
+        return buf, int(payload.nbytes)
 
     def dispenser():
         """Callback sources: hand (slab_i, lo, hi) index jobs to the
@@ -1599,20 +1814,22 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         try:
                             if mspec is None:
                                 block = source.produce_slab(lo, hi)
-                                buf = _upload_slab(block, mesh, split)
+                                buf, bnb = _encode_upload(
+                                    block, block.shape, 0)
                             else:
                                 # per-process ingest contract: produce
                                 # and upload ONLY this host's shard of
-                                # the slab (global coordinates)
+                                # the slab (global coordinates); with a
+                                # codec armed the LOCAL shard encodes,
+                                # so DCN/gloo ingest bytes shrink too
                                 llo, lhi = mspec.local_range(lo, hi)
                                 block = source.produce_slab(llo, lhi)
-                                buf = _upload_slab_mh(
-                                    block, mesh, split,
-                                    mspec.slab_shape(lo, hi), llo - lo)
+                                buf, bnb = _encode_upload(
+                                    block, mspec.slab_shape(lo, hi),
+                                    llo - lo)
                             tsec = _clock() - t0
                             if sp is not None:
-                                sp.set(bytes=int(block.nbytes), lo=lo,
-                                       hi=hi)
+                                sp.set(bytes=bnb, lo=lo, hi=hi)
                         except BaseException as exc:  # noqa: BLE001
                             _obs.end(sp, error=type(exc).__name__)
                             _act_exit()
@@ -1625,8 +1842,8 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                         _obs.end(sp)
                         _act_exit()
                         break
-                    bnb = int(block.nbytes)  # LOCAL bytes: what this
-                    del block                # process acquired/uploaded
+                    del block          # bnb = the LOCAL WIRE bytes this
+                    #                    process acquired and uploaded
                     rsq.put(i, (buf, bnb, tsec, hi))
         except BaseException as exc:        # noqa: BLE001 — re-raised in
             rsq.fault(exc)                  # the consumer thread
@@ -1693,36 +1910,41 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                             llo, lhi = mspec.local_range(lo, hi)
                             axis0_off = llo - lo
                             block = block[llo - lo:lhi - lo]
+                        # acquire the WIRE bytes (exact: codecs keep the
+                        # raw shape, only the itemsize changes) — the
+                        # arbiter budgets what will actually occupy the
+                        # ring, and the release below mirrors it
+                        want = (int(block.size)
+                                * codec_obj.wire_dtype(
+                                    source.dtype).itemsize
+                                if codec_obj is not None
+                                else int(block.nbytes))
                         if lease is not None and not lease.acquire(
-                                int(block.nbytes), stop=stop):
+                                want, stop=stop):
                             return
                         attempt = 0
                         prev = None
                         while True:
                             try:
-                                if mspec is None:
-                                    buf = _upload_slab(block, mesh,
-                                                       split)
-                                else:
-                                    buf = _upload_slab_mh(
-                                        block, mesh, split,
-                                        mspec.slab_shape(lo, hi),
-                                        axis0_off)
+                                buf, bnb = _encode_upload(
+                                    block,
+                                    block.shape if mspec is None
+                                    else mspec.slab_shape(lo, hi),
+                                    axis0_off)
                                 break
                             except BaseException as exc:  # noqa: BLE001
                                 # the block is in hand (an iterator
                                 # cannot re-produce it), so the retry
-                                # budget covers the UPLOAD here
+                                # budget covers the ENCODE + UPLOAD here
                                 prev = _retry_or_raise(i, attempt, prev,
                                                        exc)
                                 attempt += 1
                         tsec = _clock() - t0
                         if sp is not None:
-                            sp.set(bytes=int(block.nbytes), lo=lo, hi=hi)
+                            sp.set(bytes=bnb, lo=lo, hi=hi)
                     finally:
                         _obs.end(sp)
                         _act_exit()
-                    bnb = int(block.nbytes)
                     del block
                     rsq.put(i, (buf, bnb, tsec, hi))
                     i += 1
@@ -1854,7 +2076,9 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                                       rendezvous=not (abort
                                                       and mspec
                                                       is not None),
-                                      remap_from=ck_remap)
+                                      remap_from=ck_remap,
+                                      codec=codec_obj.name
+                                      if codec_obj is not None else None)
             _engine.record_checkpoint(nb, _clock() - t0)
             if csp is not None:
                 csp.set(bytes=nb)
@@ -1894,8 +2118,12 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                 # mirror acquires or the serve budget drifts
                 ingest += tsec
                 t0 = _clock()
+                wshape = (buf[0].shape if isinstance(buf, tuple)
+                          else buf.shape)
                 csp = _obs.begin("stream.compute",
-                                 slab=start_slab + slab_i)
+                                 slab=start_slab + slab_i,
+                                 **({"codec": codec_obj.name}
+                                    if codec_obj is not None else {}))
                 _chaos.hit("stream.dispatch")
                 if mspec is not None:
                     # the pod collective seam: this dispatch enqueues a
@@ -1911,20 +2139,37 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
                             "ignore",
                             message="Some donated buffers were not usable")
                         try:
-                            if pend is None:
-                                prog = _slab_program(
-                                    source, terminal, buf.shape, ddof,
-                                    rfunc, comps=comps,
-                                    sharded=mspec is not None)
-                                pend = prog(buf)
-                                pend_bytes = slab_bytes
-                            else:
-                                # level-0 fold fused into the dispatch
-                                prog = _slab_program(
-                                    source, terminal, buf.shape, ddof,
-                                    rfunc, fused=True, comps=comps,
-                                    sharded=mspec is not None)
-                                pairp = prog(buf, pend)
+                            # with a codec armed the dispatch IS the
+                            # fused on-device decode — surfaced on the
+                            # timeline as a stream.decode span nested
+                            # in this slab's stream.compute (ended in
+                            # the finally so a faulting dispatch never
+                            # leaks it)
+                            dsp = (_obs.begin("stream.decode",
+                                              codec=codec_obj.name,
+                                              slab=start_slab + slab_i)
+                                   if codec_obj is not None else None)
+                            try:
+                                if pend is None:
+                                    prog = _slab_program(
+                                        source, terminal, wshape, ddof,
+                                        rfunc, comps=comps,
+                                        sharded=mspec is not None,
+                                        codec_obj=codec_obj)
+                                    pend = prog(buf)
+                                    pend_bytes = slab_bytes
+                                    pairp = None
+                                else:
+                                    # level-0 fold fused in
+                                    prog = _slab_program(
+                                        source, terminal, wshape, ddof,
+                                        rfunc, fused=True, comps=comps,
+                                        sharded=mspec is not None,
+                                        codec_obj=codec_obj)
+                                    pairp = prog(buf, pend)
+                            finally:
+                                _obs.end(dsp)
+                            if pairp is not None:
                                 pend = None
                                 _fold_push(pairp)
                                 pending_sync.append(

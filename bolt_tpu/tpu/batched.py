@@ -90,6 +90,44 @@ def bucket_width(n, buckets):
     return buckets[-1]
 
 
+def autotune_buckets(hist_buckets, max_batch, min_share=0.05):
+    """Derive a bucket set from an OBSERVED batch-occupancy histogram
+    (the ``[(upper_bound, count)]`` pairs of
+    ``serve.batch_occupancy.hist``).  Each log2 band ``[2^e, 2^(e+1))``
+    holding at least ``min_share`` of the observations contributes BOTH
+    its edges as widths (clamped to ``[2, max_batch]``): the lower edge
+    ``2^e`` serves the band's exact-power occupancies with ZERO padding
+    (a steady occupancy of exactly 4 must dispatch at width 4, not pad
+    to 8), the upper edge serves the rest of the band minimally.
+    ``max_batch`` always closes the set — the
+    :class:`~bolt_tpu.serve.BatchPolicy` invariant that a full batch
+    never pads.  Returns ``None`` when the histogram holds no
+    observations (nothing to tune from — the caller keeps its static
+    buckets).
+
+    This is the WIDTH-AUTOTUNING scaffold (ROADMAP item 4 remainder):
+    ``BatchPolicy(autotune=True)`` re-derives its buckets from the
+    realised occupancy mix on every :func:`warm` re-arm, so a fleet
+    that mostly coalesces 3-at-a-time stops compiling (and padding to)
+    widths it never fills.  With autotune off — the default — the
+    static knobs are untouched."""
+    import math
+    total = sum(c for _, c in hist_buckets)
+    if not total:
+        return None
+    mb = int(max_batch)
+    out = {mb}
+    for ub, cnt in hist_buckets:
+        if not cnt or cnt / total < min_share:
+            continue
+        if not math.isfinite(ub):
+            out.add(mb)                 # overflow band: max_batch only
+            continue
+        out.add(min(mb, max(2, int(ub))))        # the band's upper edge
+        out.add(min(mb, max(2, int(ub) // 2)))   # ...and its lower edge
+    return tuple(sorted(out))
+
+
 # ---------------------------------------------------------------------
 # arming (the lazy-reduce door reads this; serve arms per batching
 # server)
@@ -369,15 +407,29 @@ def dispatch(batch, buckets, record=True):
     return n
 
 
-def warm(make, buckets=None, max_batch=None):
+def warm(make, buckets=None, max_batch=None, policy=None):
     """Pre-compile the batched executables at every bucket width for
     the batch key of ``make()``'s pipeline (the fleet analog of
     ``engine.warm_start``): each width dispatches one throwaway batch
     built from fresh ``make()`` pipelines, so a serving steady state —
     whatever occupancy mix it realises — runs ZERO fresh XLA compiles.
-    Returns the warmed widths."""
-    bks = tuple(buckets) if buckets else buckets_for(
-        max_batch if max_batch is not None else DEFAULT_MAX_BATCH)
+    Returns the warmed widths.
+
+    ``policy=`` is the autotune RE-ARM door: pass the server's live
+    :class:`~bolt_tpu.serve.BatchPolicy` and — when it was built with
+    ``autotune=True`` and the occupancy histogram has observations —
+    its bucket set is re-derived from the realised occupancy mix
+    (``policy.rearm()``) before warming, so the freshly compiled
+    widths are the ones traffic actually fills.  A static policy
+    (autotune off, the default) passes through untouched."""
+    if policy is not None:
+        policy.rearm()
+        bks = tuple(policy.buckets)
+    elif buckets:
+        bks = tuple(buckets)
+    else:
+        bks = buckets_for(
+            max_batch if max_batch is not None else DEFAULT_MAX_BATCH)
     warmed = []
     for bw in bks:
         arrs = [make() for _ in range(bw)]

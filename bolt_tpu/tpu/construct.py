@@ -196,7 +196,8 @@ class ConstructTPU:
 
     @staticmethod
     def fromcallback(fn, shape, context=None, axis=(0,), dtype=None,
-                     chunks=None, checkpoint=None, per_process=False):
+                     chunks=None, checkpoint=None, per_process=False,
+                     codec=None):
         """Build a distributed array by calling ``fn`` per index range —
         the sharded data-loader slot.
 
@@ -237,6 +238,15 @@ class ConstructTPU:
         Single-process meshes accept the flag as a no-op (local range =
         the whole slab), so one loader runs unchanged from laptop to
         pod.
+
+        ``codec=`` names an ingest codec (the ``bolt_tpu.tpu.codec``
+        registry: ``"bf16"``/``"f16"``/``"int8"``/``"delta-f32"``):
+        streamed runs over this source ENCODE each slab on the
+        uploader workers and DECODE on device inside the slab program,
+        shipping the wire bytes instead of the raw ones.  Wins over
+        any ``stream.codec()`` scope; materialising consumers ignore
+        it (they upload raw).  Lossy codecs are an explicit accuracy
+        opt-in — see the codec module's contract table.
         """
         from bolt_tpu.tpu.array import BoltArrayTPU
         explicit = dtype is not None
@@ -258,7 +268,7 @@ class ConstructTPU:
             from bolt_tpu import stream as _streamlib
             src = _streamlib.StreamSource.from_callback(
                 fn, shape, split, dtype, mesh, chunks=chunks,
-                checkpoint=checkpoint)
+                checkpoint=checkpoint, codec=codec)
             return BoltArrayTPU._streamed(src)
         # dtype=None means "whatever the callback produces" (the loader
         # knows its storage dtype); an explicit dtype converts each block
@@ -283,7 +293,7 @@ class ConstructTPU:
 
     @staticmethod
     def fromiter(blocks, shape, context=None, axis=(0,), dtype=None,
-                 checkpoint=None):
+                 checkpoint=None, codec=None):
         """Lazy streaming construction from an ITERABLE of consecutive
         record blocks — the sequential twin of :meth:`fromcallback` for
         sources that cannot random-access (a decompression stream, a
@@ -334,7 +344,8 @@ class ConstructTPU:
         from bolt_tpu import stream as _streamlib
         src = _streamlib.StreamSource.from_iter(blocks, shape, split,
                                                 dtype, mesh,
-                                                checkpoint=checkpoint)
+                                                checkpoint=checkpoint,
+                                                codec=codec)
         return BoltArrayTPU._streamed(src)
 
     @staticmethod

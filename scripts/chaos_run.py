@@ -83,15 +83,22 @@ def child_main(argv):
     for spec in filter(None, args.get("--arm", "").split(",")):
         seam, nth, action = spec.split(":")
         _chaos.inject(seam, nth=int(nth), action=action)
+    # the stream.encode seam only fires with a codec armed; the matrix
+    # cell streams the same integer-valued workload as FLOAT32 under
+    # the LOSSLESS delta codec, so the oracle compare stays exact
+    codec_name = args.get("--codec") or None
     data = _data()
+    if codec_name:
+        data = data.astype(np.float32)
 
     def loader(idx):
         time.sleep(PACE_S)
         return data[idx]
 
     mesh = jax.make_mesh((jax.device_count(),), ("k",))
-    src = bolt.fromcallback(loader, data.shape, mesh, dtype=np.float64,
-                            chunks=CHUNKS, checkpoint=ck_dir)
+    src = bolt.fromcallback(loader, data.shape, mesh, dtype=data.dtype,
+                            chunks=CHUNKS, checkpoint=ck_dir,
+                            codec=codec_name)
     t0 = clock()
     res = np.asarray(src.sum().toarray())
     wall = clock() - t0
@@ -226,21 +233,24 @@ def run_thread_variant():
 
 # where each streamed-workload seam trips (of 8 slabs): late enough
 # that a checkpoint exists, early enough that slabs remain to resume
-_STREAM_NTH = {"stream.upload": 5, "stream.dispatch": 4,
-               "stream.fold": 1, "stream.checkpoint": 3,
-               "checkpoint.meta": 3, "checkpoint.corrupt": 3}
+_STREAM_NTH = {"stream.encode": 5, "stream.upload": 5,
+               "stream.dispatch": 4, "stream.fold": 1,
+               "stream.checkpoint": 3, "checkpoint.meta": 3,
+               "checkpoint.corrupt": 3}
 _POD_NTH = {"podwatch.heartbeat": 3, "multihost.barrier": 1,
             "supervisor.elect": 1, "supervisor.rejoin": 1}
 
 
-def _run_stream_child(ck_dir, out, arm=""):
+def _run_stream_child(ck_dir, out, arm="", codec=None):
     env = dict(os.environ)
     env["BOLT_STREAM_UPLOAD_THREADS"] = "1"
     env.pop("BOLT_CHAOS", None)
-    return subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--child",
-         "--dir", ck_dir, "--out", out, "--arm", arm],
-        env=env, capture_output=True, text=True, timeout=600)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--dir", ck_dir, "--out", out, "--arm", arm]
+    if codec:
+        cmd += ["--codec", codec]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
 
 
 def pod_child_main(argv):
@@ -404,12 +414,15 @@ def _stream_cell(seam, mode, workdir):
     out = os.path.join(workdir, "out-" + tag + ".npy")
     nth = _STREAM_NTH[seam]
     arm = "%s:%d:%s" % (seam, nth, mode)
+    # the encode seam streams under the lossless codec (the seam never
+    # fires uncompressed); resume must re-encode bit-identically
+    codec = "delta-f32" if seam == "stream.encode" else None
     if seam == "checkpoint.corrupt" and mode == "raise":
         # the corruption seam's raise form ROTS the just-written state
         # under the atomic rename and lets the run continue — a later
         # kill leaves the rotted checkpoint for the resume to refuse
         arm += ",stream.upload:7:kill"
-    proc = _run_stream_child(ck, out, arm=arm)
+    proc = _run_stream_child(ck, out, arm=arm, codec=codec)
     if proc.returncode == 0:
         return ("FAIL", "armed child was supposed to die and did not")
     if mode == "kill" or "," in arm:
@@ -419,7 +432,7 @@ def _stream_cell(seam, mode, workdir):
     elif "ChaosError" not in proc.stderr:
         return ("FAIL", "raise child died WITHOUT the pointed "
                         "ChaosError:\n%s" % proc.stderr[-1500:])
-    proc = _run_stream_child(ck, out)
+    proc = _run_stream_child(ck, out, codec=codec)
     if seam == "checkpoint.corrupt" and mode == "raise":
         # recovery is impossible by design — the contract is the
         # POINTED refusal naming the file, then a clean restart

@@ -361,7 +361,12 @@ def main():
         assert _e["victim_rc"] == -9 and _e["survivors"] == 2
         assert _e["rejoined"] == 1 and _e["nproc_final"] == 3
         assert _e["bit_identical"]
-        assert _e["scenario_over_clean"] < 2.5
+        # the wall ratio is gated against the scenario's OWN clean run
+        # by bench config 13 on a quiet machine; here — examples run
+        # alongside anything — it is REPORTED, with only a loose sanity
+        # bound, so background load cannot flake the drill (the PR 13
+        # known flake: timing/count gates vs committed expectations)
+        assert _e["scenario_over_clean"] < 10
         assert _e["stale_ckpt"] == [] and _e["stale_markers"] == 0
         assert _e["arbiter_bytes"] == 0 and _e["leaked_spans"] == 0
         assert _e["blt014"] and _e["explain_supervised"]
@@ -426,6 +431,49 @@ def main():
           % (c1["batched_dispatches"] - c0["batched_dispatches"],
              c1["batched_requests"] - c0["batched_requests"], saved,
              st8j["occupancy"].get("mean")))
+
+    # ------------------------------------------------------------------
+    section("8k. stream a dataset at half the bytes: codec ingest")
+    # the ISSUE-14 lever for the transfer-bound streaming path: the
+    # SAME loader, with an ingest codec armed — uploader workers ENCODE
+    # each slab on host, half the bytes cross the link (the transfer
+    # counters are the proof), and the slab program DECODES on device
+    # fused into the fold.  Lossy codecs are an explicit opt-in with
+    # documented envelopes; the lossless "delta-f32" codec is
+    # BIT-IDENTICAL to uncompressed streaming and allowed everywhere
+    # (order statistics included).
+    from bolt_tpu import engine as _engine8k
+    from bolt_tpu import stream as _stream8k
+    big8k = (np.abs(rs.randn(512, 64, 8)) + 0.5).astype(np.float32)
+
+    def load8k(codec=None):
+        src = bolt.fromcallback(lambda idx: big8k[idx], big8k.shape,
+                                mesh, dtype=np.float32, chunks=128,
+                                codec=codec)
+        return src.map(lambda v: v + 1).sum()
+
+    rep8k = bolt.analysis.check(load8k("bf16"))
+    assert rep8k.has("BLT016")            # bytes-saved forecast
+    ref8k = np.asarray(load8k().toarray())
+    c0 = _engine8k.counters()
+    half8k = np.asarray(load8k("bf16").toarray())      # 0.5x the bytes
+    c1 = _engine8k.counters()
+    wire8k = c1["transfer_bytes"] - c0["transfer_bytes"]
+    assert wire8k == big8k.nbytes // 2    # the wire-bytes proof
+    assert np.allclose(half8k, ref8k, rtol=1e-2)       # bf16 envelope
+    exact8k = np.asarray(load8k("delta-f32").toarray())
+    assert np.array_equal(exact8k, ref8k)              # LOSSLESS
+    # the scope form: one thread's opt-in, same stack discipline as
+    # stream.uploaders — a per-source codec= always wins over it
+    with _stream8k.codec("delta-f32"):
+        assert np.array_equal(np.asarray(load8k().toarray()), ref8k)
+    print("  streamed %d MB as %d MB on the wire (%.2fx): bf16 within "
+          "1e-2, delta-f32 bit-identical, decode fused on device "
+          "(codec_bytes_raw/wire: %d/%d)"
+          % (big8k.nbytes >> 20, wire8k >> 20,
+             wire8k / big8k.nbytes,
+             c1["codec_bytes_raw"] - c0["codec_bytes_raw"],
+             c1["codec_bytes_wire"] - c0["codec_bytes_wire"]))
 
     # ------------------------------------------------------------------
     section("9. time-series pipeline: detrend -> zscore -> PCA")

@@ -942,9 +942,62 @@ def payload_precollective(pid):
     return res
 
 
+def payload_codec_pod(pid):
+    """The ISSUE-14 pod leg: each process ENCODES its local shard, so
+    per-process ingest (DCN/gloo) bytes shrink by the codec's wire
+    ratio; the lossless delta-f32 pod sum stays BIT-IDENTICAL to the
+    raw pod sum (the shard_map decode is shard-local by construction),
+    bf16 lands within its envelope, and sidecar codecs (int8) refuse
+    the multi-process mesh pointedly."""
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import engine, obs
+    from bolt_tpu.parallel import multihost
+    out = os.environ["BOLT_MH_OUT"]
+    n = int(os.environ.get("BOLT_MH_NKEYS", "64"))
+    vdim = 8
+    chunks = int(os.environ.get("BOLT_MH_CHUNKS", "16"))
+    x = _crafted(n, vdim)
+    mesh = _mesh()
+    obs.clear()
+    obs.enable()
+
+    def make(codec=None):
+        return bolt.fromcallback(lambda idx: x[idx], (n, vdim), mesh,
+                                 dtype=np.float32, chunks=chunks,
+                                 per_process=True, codec=codec)
+
+    res = {"pid": pid, "nproc": multihost.process_count()}
+    c0 = engine.counters()
+    raw = make().map(ADD1).sum().cache()
+    c1 = engine.counters()
+    dl = make("delta-f32").map(ADD1).sum().cache()
+    c2 = engine.counters()
+    bf = make("bf16").map(ADD1).sum().cache()
+    c3 = engine.counters()
+    np.save(os.path.join(out, "codec_raw.%d.npy" % pid), _value(raw))
+    np.save(os.path.join(out, "codec_delta.%d.npy" % pid), _value(dl))
+    np.save(os.path.join(out, "codec_bf16.%d.npy" % pid), _value(bf))
+    res["raw_bytes"] = c1["transfer_bytes"] - c0["transfer_bytes"]
+    res["delta_bytes"] = c2["transfer_bytes"] - c1["transfer_bytes"]
+    res["bf16_bytes"] = c3["transfer_bytes"] - c2["transfer_bytes"]
+    if multihost.process_count() > 1:
+        try:
+            make("int8").map(ADD1).sum().cache()
+            res["sidecar_refused"] = False
+        except ValueError as exc:
+            res["sidecar_refused"] = "sidecar" in str(exc)
+    else:
+        res["sidecar_refused"] = True
+    res["leaked_spans"] = obs.active_count()
+    obs.disable()
+    return res
+
+
 PAYLOADS = {
     "stream_parity": payload_stream_parity,
     "single_ref": payload_single_ref,
+    "codec_pod": payload_codec_pod,
     "resume": payload_resume,
     "bench": payload_bench,
     "reform": payload_reform,

@@ -310,6 +310,8 @@ _EXPECTED_ENGINE_KEYS = {
     "fused_stat_groups": False, "fused_stat_terminals": False,
     "coalesced_builds": False, "coalesced_compiles": False,
     "batched_dispatches": False, "batched_requests": False,
+    "codec_encode_seconds": True, "codec_bytes_raw": False,
+    "codec_bytes_wire": False,
 }
 
 

@@ -646,3 +646,85 @@ def test_batched_serving_leaks_no_spans_or_bytes(mesh):
     finally:
         obs.disable()
         obs.clear()
+
+
+# ---------------------------------------------------------------------
+# width autotuning scaffold (ISSUE 14 satellite: BatchPolicy.autotune)
+# ---------------------------------------------------------------------
+
+def test_autotune_buckets_derive_from_occupancy_histogram():
+    # synthetic log2-band histogram: mass at widths <=4 and a thin tail
+    hist = [(1.0, 0), (2.0, 10), (4.0, 30), (8.0, 1), (16.0, 0),
+            (float("inf"), 0)]
+    got = batched.autotune_buckets(hist, max_batch=16, min_share=0.05)
+    # the 8-band holds 1/41 < 5%: dropped; max_batch always closes
+    assert got == (2, 4, 16)
+    # overflow mass maps to max_batch; nothing observed -> None
+    assert batched.autotune_buckets(
+        [(2.0, 1), (float("inf"), 5)], max_batch=8) == (2, 8)
+    assert batched.autotune_buckets([(2.0, 0)], max_batch=8) is None
+
+
+def test_autotune_exact_power_occupancy_keeps_its_width():
+    # a steady occupancy of EXACTLY 4 lands in the log2 band [4, 8):
+    # both band edges must derive, so those batches dispatch at width 4
+    # instead of padding every one of them to 8 (the review finding)
+    got = batched.autotune_buckets([(8.0, 100)], max_batch=16)
+    assert got == (4, 8, 16)
+    assert batched.bucket_width(4, got) == 4
+
+
+def test_batch_policy_rearm_respects_the_autotune_knob():
+    static = serve.BatchPolicy(max_batch=16)
+    before = static.buckets
+    assert static.rearm([(4.0, 100), (float("inf"), 0)]) is False
+    assert static.buckets == before            # static knobs untouched
+
+    tuned = serve.BatchPolicy(max_batch=16, autotune=True)
+    assert "autotune" in repr(tuned)
+    assert tuned.rearm([(4.0, 100), (float("inf"), 0)]) is True
+    assert tuned.buckets == (2, 4, 16)         # band [2,4): both edges
+    assert tuned.buckets[-1] == tuned.max_batch
+    # nothing observed yet: a no-op, buckets keep their last value
+    assert tuned.rearm([(4.0, 0)]) is False
+    assert tuned.buckets == (2, 4, 16)
+
+
+def test_warm_rearms_an_autotune_policy_from_live_occupancy(mesh):
+    bs = _bases(mesh, 8)
+
+    def make(i=0):
+        return bs[i % 8].map(ADD1).sum()
+
+    pol = serve.BatchPolicy(max_batch=8, linger=0.05, autotune=True)
+    with serve.serving(workers=1, queue_limit=64, batching=pol) as sv:
+        assert sv.batching is pol
+        # park the worker so a 4-wide batch assembles, realising
+        # occupancy observations in serve.batch_occupancy.hist
+        sv.stats()["batching"]  # touch the door
+        gate = threading.Event()
+        blocker = sv.submit(gate.wait)
+        futs = [sv.submit(make(i), tenant="t") for i in range(4)]
+        gate.set()
+        [f.result(timeout=60) for f in futs]
+        blocker.result(timeout=30)
+        # re-arm on warm(): buckets re-derive from the realised mix
+        before = tuple(pol.buckets)
+        warmed = batched.warm(make, policy=pol)
+        assert tuple(warmed) == tuple(pol.buckets)
+        assert pol.buckets[-1] == pol.max_batch
+        assert set(pol.buckets) <= set(before) | {pol.max_batch}
+
+
+def test_warm_with_static_policy_keeps_buckets(mesh):
+    bs = _bases(mesh, 4)
+
+    def make(i=0):
+        return bs[i % 4].map(ADD1).sum()
+
+    pol = serve.BatchPolicy(max_batch=4)
+    with serve.serving(workers=1, batching=pol) as sv:
+        before = tuple(sv.batching.buckets)
+        warmed = batched.warm(make, policy=pol)
+        assert tuple(pol.buckets) == before    # autotune off: untouched
+        assert tuple(warmed) == before
