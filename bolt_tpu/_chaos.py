@@ -43,9 +43,28 @@ jax in sight.
 
 import os
 import signal
-import threading
+import sys
 
-_LOCK = threading.Lock()
+
+def _lockdep():
+    """The lock-inventory module (bolt_tpu/_lockdep.py), loaded by path
+    under its canonical name when the package is not imported — this
+    module must stay loadable with no bolt_tpu (and no jax) in sight,
+    and a later package import must adopt the SAME witness instance."""
+    mod = sys.modules.get("bolt_tpu._lockdep")
+    if mod is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "_lockdep.py")
+        spec = importlib.util.spec_from_file_location(
+            "bolt_tpu._lockdep", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["bolt_tpu._lockdep"] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
+_LOCK = _lockdep().lock("chaos.registry")
 _POINTS = {}            # name -> _Spec
 _ARMED = False          # the one hot-path check
 

@@ -33,6 +33,7 @@ before any compile — when error-severity findings exist::
 import contextlib
 import threading
 
+from bolt_tpu import _lockdep
 from bolt_tpu import engine as _engine
 from bolt_tpu.analysis.diagnostics import (CODES, Diagnostic,
                                            PipelineError, Report, Stage)
@@ -46,7 +47,7 @@ __all__ = ["check", "explain", "strict", "in_strict", "CODES",
 
 _tls = threading.local()
 _ACTIVE = 0                       # strict scopes alive across ALL threads
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = _lockdep.lock("analysis.strict")
 
 
 def in_strict():

@@ -1,0 +1,372 @@
+"""Static concurrency analysis: the lock-hierarchy lint pass.
+
+The runtime lockdep witness (:mod:`bolt_tpu._lockdep`) catches the
+inversions a test actually EXECUTES; this pass catches the ones it
+doesn't — every lock creation and every lexically-nested acquisition in
+the repo is checked against the declared rank table WITHOUT running a
+single thread.
+
+Rules (continuing the ``BLT1xx`` range owned by
+:mod:`bolt_tpu.analysis.astlint`):
+
+* **BLT111** — a lock created outside the declared inventory.  Raw
+  ``threading.Lock()`` / ``RLock()`` / ``Condition()`` construction in
+  package code bypasses the hierarchy entirely (the witness cannot rank
+  what it cannot see); construction must go through
+  ``_lockdep.lock/rlock/condition(name)`` — and the ``name`` must be a
+  string literal present in ``_lockdep.RANKS``, so the static table and
+  the runtime witness can never drift apart.
+* **BLT112** — a static acquisition-order inversion: a ``with`` block
+  acquiring a ranked lock lexically inside a ``with`` holding an
+  equal-or-higher-ranked one.  Rank order is the deadlock-freedom
+  proof; one inverted nesting anywhere breaks it for every thread in
+  the process.
+* **BLT113** — an indefinite blocking call (``barrier`` /
+  ``sync_global_devices``, ``Future.result()``, ``queue.get()``,
+  ``wait()``/``join()`` without a timeout, ``time.sleep``) lexically
+  under a ranked lock.  A thread parked under a lock stalls every
+  thread contending that lock for the full wait — and a COLLECTIVE
+  under a lock is the classic distributed deadlock: the peer process
+  that must join the rendezvous may first need the very lock this
+  process sleeps on.
+* **BLT114** — a compiled-executable enqueue (``.jitted(...)`` or a
+  name bound from ``.compile()`` / ``.compiled.get(...)``) outside a
+  ``with order_lock():`` block.  Per-process dispatch order IS the
+  cross-process collective contract; one unordered enqueue reorders
+  the schedule and wedges the pod (the hazard PR 7's order lock
+  exists to close — this rule makes the discipline mechanical).
+
+Same pragma escape hatch as the other chain rules: a finding on line
+*N* is suppressed by ``# lint: allow(BLT11x <reason>)`` on that line.
+
+Lexical honesty: the pass reasons about one module at a time and about
+*lexical* nesting only.  A nested ``def`` resets the held-lock stack
+(the closure runs later, not under the lock), and cross-module call
+chains are the runtime witness's job.  The two layers share ONE rank
+table — this module loads it from ``bolt_tpu._lockdep`` (stdlib-only)
+so the lint path still starts in milliseconds with no jax import.
+"""
+
+import ast
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load(modname, path):
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec so a later package import adopts this
+    # instance (one rank table, one RULES registry, process-wide)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_astlint = sys.modules.get("bolt_astlint") \
+    or _load("bolt_tpu.analysis.astlint",
+             os.path.join(_HERE, "astlint.py"))
+_lockdep = _load("bolt_tpu._lockdep",
+                 os.path.join(os.path.dirname(_HERE), "_lockdep.py"))
+
+Finding = _astlint.Finding
+_dotted = _astlint._dotted
+_pragma_lines = _astlint._pragma_lines
+iter_py_files = _astlint.iter_py_files
+
+RANKS = _lockdep.RANKS
+
+RULES = {
+    "BLT111": "lock created outside the declared _lockdep inventory",
+    "BLT112": "static lock-acquisition order inversion",
+    "BLT113": "indefinite blocking call while holding a ranked lock",
+    "BLT114": "executable enqueue outside the engine order lock",
+}
+
+# Finding.title resolves through the astlint registry; merging keeps
+# one BLT1xx namespace (and one --codes listing) across both passes
+_astlint.RULES.update(RULES)
+
+_EXEMPT = {
+    # the witness constructs the raw primitives it wraps; tests and
+    # scripts build scratch locks for their own harnesses
+    "BLT111": ("_lockdep.py", "tests" + os.sep, "scripts" + os.sep),
+    "BLT112": ("_lockdep.py", "tests" + os.sep, "scripts" + os.sep),
+    "BLT113": ("_lockdep.py", "tests" + os.sep, "scripts" + os.sep),
+    "BLT114": ("tests" + os.sep, "scripts" + os.sep),
+}
+
+# raw constructors BLT111 forbids (alias-resolved like every chain rule)
+_RAW_LOCKS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+}
+
+# the inventory factories (any import spelling of bolt_tpu._lockdep)
+_FACTORY_TAILS = {"lock", "rlock", "condition"}
+
+# dotted tails that block indefinitely regardless of arguments
+_BLOCKING_TAILS = {"barrier", "sync_global_devices", "wait_ready"}
+
+# attribute calls that block indefinitely ONLY when called with no
+# timeout at all (zero args, zero keywords)
+_BLOCKING_IF_BARE = {"wait", "result", "join", "get", "acquire"}
+
+
+def _exempt(code, path):
+    """Separator-anchored suffix match (same semantics as astlint's)."""
+    norm = os.path.normpath(path)
+    for suffix in _EXEMPT[code]:
+        if suffix.endswith(os.sep):
+            if (os.sep + suffix) in (os.sep + norm) \
+                    or norm.startswith(suffix):
+                return True
+        elif norm == suffix or norm.endswith(os.sep + suffix):
+            return True
+    return False
+
+
+def _is_lockdep_factory(resolved_name):
+    """True for any import spelling of the inventory factories:
+    ``_lockdep.lock`` / ``bolt_tpu._lockdep.rlock`` / a bare
+    ``condition`` from-imported out of the module."""
+    if resolved_name is None:
+        return False
+    head, _, tail = resolved_name.rpartition(".")
+    return tail in _FACTORY_TAILS and head.endswith("_lockdep")
+
+
+def _lock_bindings(tree, resolved):
+    """Two maps resolving lock expressions to inventory names:
+
+    * ``names``: module/function-level ``X = _lockdep.lock("n")``
+    * ``attrs``: instance-attribute ``self.x = _lockdep.rlock("n")``
+
+    (Per-module granularity: attribute names are distinctive within a
+    module here; cross-class collisions would merely merge same-module
+    bindings, never invent a rank.)"""
+    names, attrs = {}, {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        val = node.value
+        if not (isinstance(val, ast.Call)
+                and _is_lockdep_factory(resolved(val.func))
+                and val.args
+                and isinstance(val.args[0], ast.Constant)
+                and isinstance(val.args[0].value, str)):
+            continue
+        inv = val.args[0].value
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name):
+            names[tgt.id] = inv
+        elif isinstance(tgt, ast.Attribute):
+            attrs[tgt.attr] = inv
+    return names, attrs
+
+
+def _with_item_name(expr, resolved, names, attrs):
+    """Inventory name a ``with <expr>:`` item acquires, or None when
+    the expression is not a ranked lock (unresolvable expressions are
+    SKIPPED, never guessed — no false positives)."""
+    if isinstance(expr, ast.Call):
+        dotted = resolved(expr.func) or ""
+        if dotted == "order_lock" or dotted.endswith(".order_lock"):
+            return "engine.order"
+        return None
+    if isinstance(expr, ast.Name):
+        return names.get(expr.id)
+    if isinstance(expr, ast.Attribute):
+        return attrs.get(expr.attr)
+    return None
+
+
+def _enqueue_names(fn_node):
+    """Local names in ``fn_node`` bound from a compiled executable —
+    ``fn = lowered.compile()`` or ``fn = self.compiled.get(sig)`` —
+    whose later CALL is a dispatch enqueue (BLT114)."""
+    out = set()
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        val = node.value
+        if not (isinstance(val, ast.Call)
+                and isinstance(val.func, ast.Attribute)):
+            continue
+        attr = val.func.attr
+        owner = _dotted(val.func.value) or ""
+        if attr == "compile" or (attr == "get"
+                                 and owner.endswith("compiled")):
+            out.add(node.targets[0].id)
+    return out
+
+
+def _is_blocking(node, resolved):
+    """Message for a call that can block indefinitely, or None."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _BLOCKING_TAILS:
+            return "%s() is a collective/rendezvous" % fn.attr
+        if fn.attr in _BLOCKING_IF_BARE and not node.args \
+                and not node.keywords:
+            return ".%s() with no timeout blocks indefinitely" % fn.attr
+    dotted = resolved(fn)
+    if dotted == "time.sleep":
+        return "time.sleep() parks the thread"
+    return None
+
+
+def lint_source(src, path="<string>"):
+    """Run BLT111–BLT114 over one module's source; returns sorted
+    :class:`Finding` objects (the astlint class — one render format)."""
+    tree = ast.parse(src, filename=path)
+    pragmas = _pragma_lines(src)
+    findings = []
+
+    def emit(code, node, message):
+        line = getattr(node, "lineno", 0)
+        if _exempt(code, path):
+            return
+        if pragmas.get(line) == code:
+            return
+        findings.append(Finding(code, path, line,
+                                getattr(node, "col_offset", 0), message))
+
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = "%s.%s" % (node.module,
+                                                         a.name)
+
+    def resolved(node):
+        dotted = _dotted(node)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        origin = aliases.get(head)
+        if origin:
+            return origin + ("." + rest if rest else "")
+        return dotted
+
+    names, attrs = _lock_bindings(tree, resolved)
+
+    # ---- BLT111: creation sites ------------------------------------
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = resolved(node.func)
+        if dotted in _RAW_LOCKS:
+            emit("BLT111", node,
+                 "raw %s() is invisible to the lock-hierarchy witness; "
+                 "construct it through bolt_tpu._lockdep."
+                 "lock/rlock/condition(name) with a declared inventory "
+                 "name" % dotted)
+        elif _is_lockdep_factory(dotted):
+            arg = node.args[0] if node.args else None
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                emit("BLT111", node,
+                     "lock inventory name must be a string literal so "
+                     "the static pass can rank it")
+            elif arg.value not in RANKS:
+                emit("BLT111", node,
+                     "lock name %r is not in the declared inventory "
+                     "(_lockdep.RANKS); add it with a rank reflecting "
+                     "its nesting depth" % arg.value)
+
+    # ---- BLT112/113/114: the held-stack walk -----------------------
+    def walk(node, held, enqueue):
+        # a nested function's body runs LATER, not under the lock
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            enq = enqueue | _enqueue_names(node)
+            for child in ast.iter_child_nodes(node):
+                walk(child, [], enq)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                inv = _with_item_name(item.context_expr, resolved,
+                                      names, attrs)
+                if inv is None:
+                    continue
+                rank = RANKS.get(inv)
+                if rank is None:
+                    continue
+                for outer_name, outer_rank in held:
+                    if outer_rank >= rank and outer_name != inv:
+                        emit("BLT112", item.context_expr,
+                             "acquiring %r (rank %d) inside %r (rank "
+                             "%d) inverts the declared order; "
+                             "restructure so the lower rank is taken "
+                             "first, or re-rank the inventory"
+                             % (inv, rank, outer_name, outer_rank))
+                acquired.append((inv, rank))
+            inner = held + acquired
+            for child in node.body:
+                walk(child, inner, enqueue)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                why = _is_blocking(node, resolved)
+                if why is not None:
+                    emit("BLT113", node,
+                         "%s while holding %r — every thread "
+                         "contending that lock stalls for the full "
+                         "wait (a collective here is the classic "
+                         "cross-process deadlock); release the lock "
+                         "first or bound the wait"
+                         % (why, held[-1][0]))
+            fn = node.func
+            is_enqueue = (isinstance(fn, ast.Attribute)
+                          and fn.attr == "jitted") \
+                or (isinstance(fn, ast.Name) and fn.id in enqueue)
+            if is_enqueue \
+                    and not any(n == "engine.order" for n, _ in held):
+                emit("BLT114", node,
+                     "compiled-executable enqueue outside `with "
+                     "order_lock():` — per-process dispatch order is "
+                     "the cross-process collective contract; an "
+                     "unordered enqueue can wedge the pod")
+        for child in ast.iter_child_nodes(node):
+            walk(child, held, enqueue)
+
+    walk(tree, [], set())
+    findings.sort(key=lambda f: (f.line, f.col))
+    return findings
+
+
+def lint_file(path):
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def lint_paths(paths):
+    findings = []
+    for p in paths:
+        if os.path.isdir(p):
+            for f in iter_py_files(p):
+                findings.extend(lint_file(f))
+        else:
+            findings.extend(lint_file(p))
+    return findings
+
+
+def lint_package(root=None):
+    """Run the concurrency pass over ``bolt_tpu`` (zero findings is a
+    tier-1 invariant, same as the astlint pass)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return lint_paths([root])

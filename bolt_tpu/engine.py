@@ -43,12 +43,15 @@ references, so cached entries pin no device memory.
 """
 
 import contextlib
+import hashlib
 import os
+import re
 import threading
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 import jax
 
+from bolt_tpu import _lockdep
 from bolt_tpu.obs import metrics as _metrics
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.obs.trace import clock as _clock
@@ -73,7 +76,7 @@ _AOT = os.environ.get("BOLT_ENGINE_AOT", "1").lower() not in ("0", "false")
 _DONATE_MIN_BYTES = int(os.environ.get("BOLT_DONATE_MIN_BYTES",
                                        str(64 << 20)))
 
-_LOCK = threading.RLock()            # guards the executable cache
+_LOCK = _lockdep.rlock("engine.cache")   # guards the executable cache
 _CACHE = OrderedDict()               # key -> _Entry
 _BUILDING = {}                       # key -> Event: in-flight builds, so
                                      # concurrent same-key misses coalesce
@@ -592,7 +595,7 @@ def record_stream(chunks, ingest_s, compute_s, wall_s, overlap_s, depth,
 # tenant's enqueue within the process.  Running MULTIPLE tenants with
 # cross-host collectives concurrently would need a cross-process order
 # agreement on top — not provided yet (ROADMAP item 2 remainder).
-_ORDER_LOCK = threading.RLock()
+_ORDER_LOCK = _lockdep.rlock("engine.order")
 
 
 def order_lock():
@@ -601,6 +604,91 @@ def order_lock():
     (``multihost.barrier``'s rendezvous) — taking it keeps every
     per-device queue observing ONE program order per process."""
     return _ORDER_LOCK
+
+
+# ---------------------------------------------------------------------
+# dispatch-schedule digest (the cross-process order verifier's feed)
+# ---------------------------------------------------------------------
+#
+# The order lock serialises enqueues WITHIN a process; across processes
+# nothing checks that every pod member enqueued the SAME programs in
+# the SAME order — the divergence class behind ROADMAP item 3's
+# remaining gap, and it surfaces as a gloo collective hang, the worst
+# possible error message.  So the engine keeps a rolling digest of the
+# enqueue schedule: under the order lock, every executable enqueue
+# folds its program key (address-stabilised repr — `<function f at
+# 0x..>` varies per process, the qualified name does not) into a
+# sha256 chain.  `multihost.verify_schedule()` exchanges the digest at
+# a rendezvous and turns any divergence into a pointed error naming
+# the first divergent program instead of a hang.
+
+_SCHED_DIGEST = hashlib.sha256(b"bolt-schedule").hexdigest()
+_SCHED_COUNT = 0
+_SCHED_RECENT = deque(maxlen=64)      # always-on tail, for error context
+_SCHED_LOG = [] if os.environ.get("BOLT_SCHED_LOG", "") == "1" else None
+
+
+def _stable_key(key):
+    """Cross-process-stable rendering of a program key: repr with CPython
+    object addresses stripped (function/method/partial reprs embed
+    them; everything else in a key — shapes, dtypes, mesh geometry —
+    reprs identically on every process running the same program)."""
+    return re.sub(r" at 0x[0-9a-fA-F]+", "", repr(key))
+
+
+def _schedule_note(key):
+    """Fold one enqueue into the schedule digest.  Caller holds
+    _ORDER_LOCK — the digest order IS the enqueue order."""
+    global _SCHED_DIGEST, _SCHED_COUNT
+    text = _stable_key(key)
+    _SCHED_DIGEST = hashlib.sha256(
+        (_SCHED_DIGEST + "|" + text).encode()).hexdigest()
+    _SCHED_COUNT += 1
+    _SCHED_RECENT.append(text)
+    if _SCHED_LOG is not None:
+        _SCHED_LOG.append(text)
+
+
+def schedule_digest():
+    """``(count, hexdigest)`` of this process's enqueue schedule so far
+    (consistent: read under the order lock)."""
+    with _ORDER_LOCK:
+        return _SCHED_COUNT, _SCHED_DIGEST
+
+
+def schedule_recent():
+    """The last few (<= 64) stabilised program keys enqueued — the
+    always-on context a divergence error quotes."""
+    with _ORDER_LOCK:
+        return list(_SCHED_RECENT)
+
+
+def schedule_log():
+    """The FULL ordered key log, or ``None`` unless armed
+    (:func:`schedule_log_arm` / ``BOLT_SCHED_LOG=1`` — the multihost
+    harness arms it so a divergence names the exact first divergent
+    key, not just the digest mismatch)."""
+    with _ORDER_LOCK:
+        return None if _SCHED_LOG is None else list(_SCHED_LOG)
+
+
+def schedule_log_arm(on=True):
+    """Arm (or drop) full schedule-key logging."""
+    global _SCHED_LOG
+    with _ORDER_LOCK:
+        _SCHED_LOG = [] if on else None
+
+
+def schedule_reset():
+    """Reset digest, count and logs (tests; NOT for pod runs — peers
+    must reset at the same schedule point or digests diverge)."""
+    global _SCHED_DIGEST, _SCHED_COUNT
+    with _ORDER_LOCK:
+        _SCHED_DIGEST = hashlib.sha256(b"bolt-schedule").hexdigest()
+        _SCHED_COUNT = 0
+        _SCHED_RECENT.clear()
+        if _SCHED_LOG is not None:
+            del _SCHED_LOG[:]
 
 
 def _leaf_sig(x):
@@ -623,15 +711,17 @@ class _Dispatch:
     signature; falls back to plain jit dispatch for argument structures
     the AOT path cannot serve (and counts the fallback)."""
 
-    __slots__ = ("jitted", "compiled", "_compile_lock")
+    __slots__ = ("jitted", "compiled", "key", "_compile_lock")
 
-    def __init__(self, jitted):
+    def __init__(self, jitted, key=None):
         self.jitted = jitted
         self.compiled = {}           # signature -> compiled executable
+        self.key = key               # engine cache key: what the
+        #                              schedule digest folds per enqueue
         # serialises the per-signature lower+compile: N tenants racing
         # the same signature must produce ONE aot compile (the losers
         # wait and count coalesced_compiles), not N identical XLA runs
-        self._compile_lock = threading.Lock()
+        self._compile_lock = _lockdep.lock("engine.compile")
 
     def lower(self, *args, **kwargs):
         """Delegate to the wrapped jitted callable so cached entries stay
@@ -640,6 +730,10 @@ class _Dispatch:
         return self.jitted.lower(*args, **kwargs)
 
     def __call__(self, *args):
+        _lockdep.note_dispatch()     # armed witness: no ranked lock may
+        #                              be held across a dispatch (the
+        #                              held-lock-across-collective
+        #                              hazard; DISPATCH_SAFE excepted)
         sp = _obs.begin("engine.dispatch")
         t0 = _clock()
         try:
@@ -655,6 +749,7 @@ class _Dispatch:
         if not _AOT:
             _COUNTERS.add("fallbacks")
             with _ORDER_LOCK:
+                _schedule_note(self.key)
                 return self.jitted(*args)
         try:
             leaves, treedef = jax.tree_util.tree_flatten(args)
@@ -696,6 +791,7 @@ class _Dispatch:
             if fn is not None:
                 try:
                     with _ORDER_LOCK:
+                        _schedule_note(self.key)
                         return fn(*args)
                 except (TypeError, ValueError):
                     # argument-validation drift the leaf model missed
@@ -714,6 +810,7 @@ class _Dispatch:
         # BOLT_ENGINE_AOT=0 is an explicit single-user debug mode; the
         # hot AOT path above compiles OUTSIDE the lock.
         with _ORDER_LOCK:
+            _schedule_note(self.key)
             return self.jitted(*args)
 
 
@@ -761,7 +858,7 @@ def get(key, builder):
     if sp is not None and isinstance(key, tuple) and key:
         sp.set(family=str(key[0]))
     try:
-        entry = _Dispatch(builder())
+        entry = _Dispatch(builder(), key=key)
     except BaseException:
         with _LOCK:
             _BUILDING.pop(key, None)

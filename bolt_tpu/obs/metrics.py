@@ -28,7 +28,28 @@ Standard library only; importable with no jax anywhere in sight.
 """
 
 import math
-import threading
+import os
+import sys
+
+
+def _lockdep():
+    """bolt_tpu/_lockdep.py (the ranked lock inventory), loaded by path
+    under its canonical name when the package is not imported: this
+    module stays stdlib-only standalone, and a later ``bolt_tpu``
+    import adopts the SAME witness instance.  The registry lock is the
+    hierarchy's LEAF (``obs.registry``): every critical section in the
+    package may count, so nothing may nest inside it."""
+    mod = sys.modules.get("bolt_tpu._lockdep")
+    if mod is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "_lockdep.py")
+        spec = importlib.util.spec_from_file_location(
+            "bolt_tpu._lockdep", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["bolt_tpu._lockdep"] = mod
+        spec.loader.exec_module(mod)
+    return mod
 
 
 class Counter:
@@ -254,7 +275,7 @@ class Registry:
     registered (see module docstring for why that lock matters)."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _lockdep().rlock("obs.registry")
         self._metrics = {}
 
     def _register(self, name, factory):
@@ -317,3 +338,31 @@ def registry():
     """The process-wide default registry (the engine's counters live
     here under the group name ``engine``)."""
     return _REGISTRY
+
+
+# every thread the package constructs carries one of these name
+# prefixes (lint rule BLT108 confines construction to these homes)
+_THREAD_PREFIXES = (
+    "bolt-serve-worker-",         # serve.py scheduler pool
+    "bolt-stream-prefetch",       # stream.py dispenser/prefetch lead
+    "bolt-stream-upload-",        # stream.py uploader pool
+    "bolt-podwatch-heartbeat",    # podwatch liveness watch
+    "bolt-supervisor",            # pod recovery supervisor driver
+)
+
+
+def thread_census():
+    """Live bolt-owned worker threads, ``{name: count}`` grouped by
+    the blessed thread-name prefixes.  Empty when every pool, watch
+    and supervisor has been torn down — the hygiene invariant the
+    bench ``--check`` gate and the test suite assert (a leaked thread
+    here is a server/executor that skipped its shutdown path)."""
+    import threading
+    out = {}
+    for t in threading.enumerate():
+        for p in _THREAD_PREFIXES:
+            if t.name.startswith(p):
+                key = p.rstrip("-")
+                out[key] = out.get(key, 0) + 1
+                break
+    return out

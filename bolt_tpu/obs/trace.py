@@ -36,6 +36,7 @@ Chrome trace-event file.  This module imports ONLY the standard library.
 import functools
 import itertools
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -43,10 +44,29 @@ from collections import deque
 # THE timing primitive (see module docstring / lint rule BLT106)
 clock = time.perf_counter
 
+
+def _lockdep():
+    """bolt_tpu/_lockdep.py (the ranked lock inventory), loaded by path
+    under its canonical name when the package is not imported: this
+    module stays stdlib-only standalone, and a later ``bolt_tpu``
+    import adopts the SAME witness instance."""
+    mod = sys.modules.get("bolt_tpu._lockdep")
+    if mod is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "_lockdep.py")
+        spec = importlib.util.spec_from_file_location(
+            "bolt_tpu._lockdep", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["bolt_tpu._lockdep"] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
 _RING_DEFAULT = 4096
 
 _ON = False                      # the one hot-path check
-_LOCK = threading.Lock()         # guards ring + active count
+_LOCK = _lockdep().lock("obs.trace")   # guards ring + active count
 _RING = deque(maxlen=_RING_DEFAULT)
 _ACTIVE = 0                      # begun-but-not-ended spans (leak gate)
 _IDS = itertools.count(1)

@@ -58,6 +58,10 @@ installed).  Peer-death DETECTION therefore belongs entirely to
 the gloo transport-error signatures.
 """
 
+import hashlib
+import json
+import time
+
 import numpy as np
 
 import jax
@@ -310,7 +314,140 @@ def barrier(name):
     from jax.experimental import multihost_utils
     from bolt_tpu import engine as _engine
     with _engine.order_lock():
-        multihost_utils.sync_global_devices(str(name))
+        # the barrier IS a collective program enqueue: it must hold the
+        # order lock for exactly the reason BLT113 flags collectives
+        # under locks everywhere else — here the lock serialises this
+        # enqueue against every other dispatch, keeping the per-device
+        # queues aligned across processes
+        multihost_utils.sync_global_devices(str(name))  # lint: allow(BLT113 the barrier is itself an ordered enqueue)
+
+
+# ---------------------------------------------------------------------
+# the dispatch-schedule verifier (the engine digest's rendezvous)
+# ---------------------------------------------------------------------
+
+class ScheduleDivergenceError(RuntimeError):
+    """The pod's processes enqueued DIFFERENT program schedules — the
+    divergence that otherwise surfaces as a silent gloo collective
+    hang.  Carries the first divergent position when key logging was
+    armed (``BOLT_SCHED_LOG=1``)."""
+
+    def __init__(self, message, peer=None, index=None, local_key=None):
+        super().__init__(message)
+        self.peer = peer              # the diverging process id
+        self.index = index            # first divergent schedule slot
+        self.local_key = local_key    # this process's key at that slot
+
+
+_VERIFY_SEQ = [0]                     # per-process call counter: every
+#                                       process calls verify_schedule at
+#                                       the same program points (the
+#                                       barrier-name discipline), so the
+#                                       counter yields matching tags
+
+_NOTE_KEYS = 256                      # per-key hashes shipped at most
+_NOTE_CHARS = 160                     # chars of each key text shipped
+
+
+def _schedule_payload():
+    from bolt_tpu import engine as _engine
+    count, digest = _engine.schedule_digest()
+    payload = {"count": count, "digest": digest}
+    log = _engine.schedule_log()
+    if log is not None:
+        tail = log[-_NOTE_KEYS:]
+        payload["base"] = len(log) - len(tail)
+        payload["hashes"] = [hashlib.sha256(t.encode()).hexdigest()[:12]
+                             for t in tail]
+        payload["texts"] = [t[:_NOTE_CHARS] for t in tail]
+    return payload
+
+
+def _first_divergence(mine, theirs):
+    """First divergent schedule slot between two payloads carrying key
+    logs, or ``None`` when the logs don't overlap usefully."""
+    if "hashes" not in mine or "hashes" not in theirs:
+        return None
+    base = max(mine["base"], theirs["base"])
+    a = mine["hashes"][base - mine["base"]:]
+    b = theirs["hashes"][base - theirs["base"]:]
+    for i, (ha, hb) in enumerate(zip(a, b)):
+        if ha != hb:
+            return base + i
+    if len(a) != len(b):
+        return base + min(len(a), len(b))
+    return None
+
+
+def verify_schedule(name="sched", timeout=30.0, transport=None):
+    """Cross-process dispatch-order check: exchange this process's
+    schedule digest (:func:`bolt_tpu.engine.schedule_digest`) with
+    every pod member and FAIL LOUDLY on divergence.
+
+    The engine's order lock guarantees one enqueue order per process;
+    nothing guarantees the pods agreed on it — a divergent schedule
+    runs mismatched collectives and hangs in gloo with no diagnosis.
+    Call this at any quiet point (every process must call it at the
+    SAME program point, like a barrier): matching schedules return the
+    common digest; a mismatch raises :class:`ScheduleDivergenceError`
+    naming the diverging peer — and, when key logging is armed
+    (``BOLT_SCHED_LOG=1`` / ``engine.schedule_log_arm()``), the first
+    divergent slot and this process's program key in it.
+
+    Single-process: returns the local digest without any exchange."""
+    from bolt_tpu import engine as _engine
+    payload = _schedule_payload()
+    if process_count() <= 1:
+        return payload["digest"]
+    pid = process_index()
+    nproc = process_count()
+    if transport is None:
+        transport = podwatch.transport() if podwatch.active() \
+            else podwatch._default_transport(epoch=podwatch.epoch())
+    if transport is None:
+        raise RuntimeError(
+            "verify_schedule needs a podwatch transport (shared "
+            "BOLT_POD_HB_DIR or the jax.distributed KV store)")
+    _VERIFY_SEQ[0] += 1
+    key = "sched.%s.%d" % (name, _VERIFY_SEQ[0])
+    transport.note_set(key, pid, json.dumps(payload))
+    deadline = time.monotonic() + timeout
+    while True:
+        notes = transport.note_read(key)
+        if len(notes) >= nproc:
+            break
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                "verify_schedule %r: only %d/%d processes published a "
+                "schedule digest within %.1fs (peers missing: %s)"
+                % (key, len(notes), nproc, timeout,
+                   sorted(set(range(nproc)) - set(notes))))
+        time.sleep(0.02)
+    for peer in sorted(notes):
+        if peer == pid:
+            continue
+        theirs = json.loads(notes[peer])
+        if theirs["digest"] == payload["digest"]:
+            continue
+        idx = _first_divergence(payload, theirs)
+        local_key = None
+        if idx is not None and "texts" in payload:
+            off = idx - payload["base"]
+            if 0 <= off < len(payload["texts"]):
+                local_key = payload["texts"][off]
+        detail = "" if idx is None else (
+            "; first divergent slot %d, local key %s"
+            % (idx, local_key if local_key is not None
+               else "<beyond local log>"))
+        raise ScheduleDivergenceError(
+            "dispatch schedules diverged: process %d enqueued %d "
+            "program(s) [digest %s..], process %d enqueued %d [digest "
+            "%s..]%s — every process must enqueue the SAME programs in "
+            "the SAME order (arm BOLT_SCHED_LOG=1 for exact keys)"
+            % (pid, payload["count"], payload["digest"][:12],
+               peer, theirs["count"], theirs["digest"][:12], detail),
+            peer=peer, index=idx, local_key=local_key)
+    return payload["digest"]
 
 
 # ---------------------------------------------------------------------

@@ -78,6 +78,7 @@ import threading
 import time
 
 from bolt_tpu import _chaos
+from bolt_tpu import _lockdep
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.obs.trace import clock as _clock
 
@@ -321,6 +322,34 @@ class FileTransport:
                 pass
         return sorted(out)
 
+    # -- the generic per-process note channel (schedule digests) -------
+    # One small payload per (key, pid), last-writer-wins, read back as
+    # {pid: text} — the exchange primitive multihost.verify_schedule
+    # uses to compare dispatch-schedule digests across the pod.
+
+    def note_set(self, key, pid, text):
+        path = os.path.join(
+            self.path, "note.e%d.%s.p%d" % (self.epoch,
+                                            _safe_ident(key), int(pid)))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+    def note_read(self, key):
+        out = {}
+        for p in glob.glob(os.path.join(
+                self.path,
+                "note.e%d.%s.p*" % (self.epoch, _safe_ident(key)))):
+            if p.endswith(".tmp"):
+                continue
+            try:
+                with open(p) as f:
+                    out[int(p.rsplit(".p", 1)[1])] = f.read()
+            except (ValueError, OSError):
+                pass                  # a note mid-rename: next poll sees it
+        return out
+
     # -- the quiesce gate marker (single writer: process 0) ------------
 
     def quiesce_mark(self, watermark):
@@ -557,6 +586,29 @@ class KVTransport:
                 pass
         return sorted(out)
 
+    def note_set(self, key, pid, text):
+        try:
+            self.client.key_value_set(
+                "bolt/note/e%d/%s/p%d" % (self.epoch, _safe_ident(key),
+                                          int(pid)), text)
+        except Exception as exc:      # noqa: BLE001
+            self.failed = exc
+            raise
+
+    def note_read(self, key):
+        try:
+            items = self.client.key_value_dir_get(
+                "bolt/note/e%d/%s/" % (self.epoch, _safe_ident(key)))
+        except Exception:             # noqa: BLE001 — an unanswerable
+            return {}                 # store has no notes yet
+        out = {}
+        for k, val in items:
+            try:
+                out[int(k.rsplit("/p", 1)[1])] = val
+            except (IndexError, ValueError):
+                pass
+        return out
+
     def quiesce_mark(self, watermark):
         self.client.key_value_set(
             "bolt/quiesce/e%d/w%d" % (self.epoch, int(watermark)), "1")
@@ -598,7 +650,7 @@ def _default_transport(epoch):
 
 # callbacks survive watch restarts (a server subscribed before a reform
 # keeps its subscription after); handles deregister
-_CB_LOCK = threading.Lock()
+_CB_LOCK = _lockdep.lock("podwatch.callbacks")
 _DEATH_CBS = {}                       # handle -> cb(pid)
 _REFORM_CBS = {}                      # handle -> cb()
 _REJOIN_CBS = {}                      # handle -> cb(ident)
@@ -615,7 +667,7 @@ class _Watch:
         self.nproc = int(nproc)
         self.interval = float(interval)
         self.timeout = float(timeout)
-        self.lock = threading.Lock()
+        self.lock = _lockdep.lock("podwatch.state")
         self.stop_ev = threading.Event()
         self.seq = 0
         self.started = _clock()
@@ -738,7 +790,7 @@ class _Watch:
 
 
 _WATCH = None
-_WATCH_LOCK = threading.Lock()
+_WATCH_LOCK = _lockdep.lock("podwatch.watch")
 _EPOCH = [0]
 
 
@@ -1071,7 +1123,7 @@ def sweep_dead_markers():
 # pod-run accounting + the quiesce latch (the supervisor's seams)
 # ---------------------------------------------------------------------
 
-_BUSY_LOCK = threading.Lock()
+_BUSY_LOCK = _lockdep.lock("podwatch.busy")
 _BUSY = [0]                           # live pod stream runs, this process
 _QUIESCE = [None]                     # reason string while requested
 

@@ -73,6 +73,7 @@ import threading
 import time
 
 from bolt_tpu import _chaos
+from bolt_tpu import _lockdep
 from bolt_tpu.obs import metrics as _metrics
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.obs.trace import clock as _clock
@@ -147,7 +148,7 @@ def _fastfail_init_timeout():
 # ---------------------------------------------------------------------
 
 _ACTIVE = None
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = _lockdep.lock("supervisor.active")
 
 
 class Supervisor:
@@ -178,7 +179,7 @@ class Supervisor:
         # jax's default 120s init window
         self.init_timeout = init_timeout
         self.failed = None             # the giveup error, if any
-        self._lock = threading.Lock()
+        self._lock = _lockdep.lock("supervisor.state")
         # last plan generation DRIVEN by this member — the follower
         # adoption floor is _gen + 1, so attach() must seed it with
         # the plan it joined by or a retained stale generation on the

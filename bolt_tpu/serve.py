@@ -102,6 +102,7 @@ import os
 import threading
 from collections import OrderedDict, deque
 
+from bolt_tpu import _lockdep
 from bolt_tpu import engine as _engine
 from bolt_tpu.obs import metrics as _metrics
 from bolt_tpu.obs import trace as _obs
@@ -283,7 +284,7 @@ class DeviceArbiter:
         if self.budget <= 0:
             raise ValueError("arbiter budget must be positive, got %d"
                              % self.budget)
-        self._cond = threading.Condition()
+        self._cond = _lockdep.condition("serve.arbiter")
         self._used = 0
         self._queues = OrderedDict()       # tenant -> deque[_Ticket]
         self._ring = deque()               # tenants with waiters (RR)
@@ -426,7 +427,7 @@ class ArbiterLease:
     def __init__(self, arbiter, tenant):
         self.arbiter = arbiter
         self.tenant = tenant
-        self._lock = threading.Lock()
+        self._lock = _lockdep.lock("serve.lease")
         self._out = 0
 
     def outstanding(self):
@@ -644,7 +645,7 @@ class Server:
             self.warm_dir = _engine.warm_start(start_warm)
         self.arbiter = DeviceArbiter(budget_bytes if budget_bytes
                                      is not None else _DEF_BUDGET)
-        self._cond = threading.Condition()
+        self._cond = _lockdep.condition("serve.scheduler")
         self._queues = OrderedDict()       # tenant -> deque of jobs
         self._ring = deque()               # tenants with queued jobs
         self._depth = 0
@@ -1455,7 +1456,7 @@ class Server:
 # ---------------------------------------------------------------------
 
 _ACTIVE = None
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = _lockdep.lock("serve.active")
 
 
 def start(workers=None, budget_bytes=None, queue_limit=None,

@@ -122,6 +122,7 @@ import jax
 import jax.numpy as jnp
 
 from bolt_tpu import _chaos
+from bolt_tpu import _lockdep
 from bolt_tpu import engine as _engine
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.obs.trace import clock as _clock
@@ -1365,7 +1366,7 @@ class _Reseq:
                  "_dead_err")
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = _lockdep.condition("stream.reseq")
         self._slots = {}
         self._next = 0
         self._exc = None
@@ -1699,7 +1700,7 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
     rsq = _Reseq()
     # concurrent-uploader accounting (the parallel-ingest proof in the
     # engine counters: stream_upload_threads records the high-water)
-    act_lock = threading.Lock()
+    act_lock = _lockdep.lock("stream.uploader_hw")
     act = {"n": 0, "hw": 0}
 
     def _act_enter():

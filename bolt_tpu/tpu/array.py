@@ -47,6 +47,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu import _lockdep
 from bolt_tpu import engine as _engine
 from bolt_tpu import stream as _streamlib
 from bolt_tpu.base import BoltArray, HostFallbackWarning
@@ -234,7 +235,7 @@ _GATHER_SLAB_BYTES = 256 << 20
 _LAST_GATHER_STATS = None
 
 
-_LRU_LOCK = threading.RLock()
+_LRU_LOCK = _lockdep.rlock("tpu.lru")
 
 
 def _lru_get(cache, key, build):

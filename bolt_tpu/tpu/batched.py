@@ -47,6 +47,7 @@ import threading
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu import _lockdep
 from bolt_tpu import engine as _engine
 from bolt_tpu.obs import trace as _obs
 from bolt_tpu.utils import prod
@@ -134,7 +135,7 @@ def autotune_buckets(hist_buckets, max_batch, min_share=0.05):
 # ---------------------------------------------------------------------
 
 _ARMED = 0
-_ARM_LOCK = threading.Lock()
+_ARM_LOCK = _lockdep.lock("batched.arm")
 
 
 def arm():

@@ -60,6 +60,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bolt_tpu import _lockdep
 from bolt_tpu import engine as _engine
 from bolt_tpu import _precision
 from bolt_tpu import stream as _streamlib
@@ -167,7 +168,7 @@ class _StatGroup:
         self.in_aval = in_aval
         self.members = []
         self.dispatched = False
-        self.lock = threading.Lock()
+        self.lock = _lockdep.lock("multistat.group")
         # a chain group carrying a deferred reduce(func) terminal
         # (bolt_tpu/tpu/batched.py's lazy door): singleton, never joined
         # by stat members — its standalone resolution is the EXACT eager

@@ -695,6 +695,13 @@ def check_configs(mesh=None):
                      "OK" if ok15 else "MISMATCH"))
             failed = failed or not ok15
     obs.disable()
+    # thread-census hygiene: every pool/watch/supervisor the configs
+    # started must be torn down — a leaked bolt-* thread is an executor
+    # that skipped its shutdown path
+    census = obs.thread_census()
+    print("thread census after all configs: %s -> %s"
+          % (census or "{}", "OK" if not census else "LEAKED"))
+    failed = failed or bool(census)
     return 1 if failed else 0
 
 

@@ -1,37 +1,99 @@
 #!/usr/bin/env python
 """Repo invariant linter CLI — the ``BLT1xx`` AST rules of
-``bolt_tpu/analysis/astlint.py`` as a fast standalone gate.
+``bolt_tpu/analysis/astlint.py`` plus the concurrency pass of
+``bolt_tpu/analysis/concurrency.py`` as a fast standalone gate.
 
 ::
 
-    python scripts/lint_bolt.py             # lint bolt_tpu/, print findings
-    python scripts/lint_bolt.py --check     # same, exit 1 on any finding
-    python scripts/lint_bolt.py --codes     # print the rule table
-    python scripts/lint_bolt.py PATH...     # lint specific files/dirs
+    python scripts/lint_bolt.py               # both passes over bolt_tpu/
+    python scripts/lint_bolt.py --check       # same, exit 1 on findings
+                                              # OR stale pragmas
+    python scripts/lint_bolt.py --concurrency # lock-hierarchy pass only
+    python scripts/lint_bolt.py --codes       # merged rule table
+    python scripts/lint_bolt.py PATH...       # lint specific files/dirs
 
-Runs in milliseconds with NO jax import: ``astlint`` is stdlib-only and
-is loaded straight from its file, skipping the ``bolt_tpu`` package
-initialisation (which would pull in jax).  The same rules run in tier-1
-as ``pytest -m lint`` (``tests/test_static_analysis.py`` asserts zero
-findings on the package).
+Runs in milliseconds with NO jax import: both lint modules are
+stdlib-only and are loaded straight from their files, skipping the
+``bolt_tpu`` package initialisation (which would pull in jax).  The
+same rules run in tier-1 as ``pytest -m lint``
+(``tests/test_static_analysis.py`` asserts zero findings on the
+package).
+
+``--check`` additionally audits every ``# lint: allow(...)`` pragma in
+the linted set: a pragma naming an unknown code, or one that no longer
+suppresses any finding (the code it excused was fixed or moved), fails
+the gate — suppressions must never outlive what they suppress.
 """
 
 import argparse
 import importlib.util
 import os
+import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# a well-formed diagnostic code; docstrings DESCRIBING the pragma
+# syntax ("allow(BLT1xx <reason>)", the parser's own source) parse as
+# pseudo-codes and are not audited
+_CODE_RE = re.compile(r"^BLT\d{3}$")
 
-def _load_astlint():
-    """Load astlint by path (no ``import bolt_tpu`` — that would
+
+def _load(modname, relpath):
+    """Load a lint module by path (no ``import bolt_tpu`` — that would
     initialise jax; this gate must stay no-jit and instant)."""
-    path = os.path.join(_REPO, "bolt_tpu", "analysis", "astlint.py")
-    spec = importlib.util.spec_from_file_location("bolt_astlint", path)
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    path = os.path.join(_REPO, "bolt_tpu", "analysis", relpath)
+    spec = importlib.util.spec_from_file_location(modname, path)
     mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def _iter_files(paths, astlint):
+    for p in paths:
+        if os.path.isdir(p):
+            for f in astlint.iter_py_files(p):
+                yield f
+        else:
+            yield p
+
+
+def stale_pragmas(paths, astlint, conc):
+    """Audit ``lint: allow`` pragmas: re-lint each pragma-bearing file
+    with the pragmas disarmed and require every pragma to (a) name a
+    known code and (b) actually suppress a finding on its line."""
+    msgs = []
+    passes = (astlint,) if conc is None else (astlint, conc)
+    for path in _iter_files(paths, astlint):
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        pragmas = astlint._pragma_lines(src)
+        if not pragmas:
+            continue
+        # disarm (same line count, so finding lines stay comparable)
+        neutered = src.replace("lint: allow(", "lint: off(")
+        hits = set()
+        for mod in passes:
+            try:
+                for f in mod.lint_source(neutered, path):
+                    hits.add((f.line, f.code))
+            except SyntaxError:
+                pass
+        for line, code in sorted(pragmas.items()):
+            if not _CODE_RE.match(code):
+                continue
+            if code not in astlint.RULES:
+                msgs.append("%s:%d: stale pragma: unknown code %r"
+                            % (path, line, code))
+            elif (line, code) not in hits:
+                msgs.append("%s:%d: stale pragma: allow(%s) no longer "
+                            "suppresses any finding — remove it"
+                            % (path, line, code))
+    return msgs
 
 
 def main(argv=None):
@@ -42,25 +104,41 @@ def main(argv=None):
                     help="files/directories to lint (default: the "
                          "bolt_tpu package)")
     ap.add_argument("--check", action="store_true",
-                    help="exit nonzero when any finding is reported "
-                         "(the CI/tier-1 gate mode)")
+                    help="exit nonzero when any finding OR stale "
+                         "pragma is reported (the CI/tier-1 gate mode)")
     ap.add_argument("--codes", action="store_true",
-                    help="print the rule table and exit")
+                    help="print the merged rule table and exit")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run only the lock-hierarchy pass "
+                         "(BLT111-BLT114)")
     args = ap.parse_args(argv)
 
-    astlint = _load_astlint()
+    astlint = _load("bolt_astlint", "astlint.py")
+    conc = _load("bolt_concurrency", "concurrency.py")
     if args.codes:
         for code in sorted(astlint.RULES):
             print("%s  %s" % (code, astlint.RULES[code]))
         return 0
 
     paths = args.paths or [os.path.join(_REPO, "bolt_tpu")]
-    findings = astlint.lint_paths(paths)
+    if args.concurrency:
+        findings = conc.lint_paths(paths)
+    else:
+        findings = astlint.lint_paths(paths) + conc.lint_paths(paths)
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
     for f in findings:
         print(f.render())
     n = len(findings)
     print("%d finding(s) over %s" % (n, ", ".join(paths)))
-    if args.check and n:
+    stale = []
+    if args.check:
+        stale = stale_pragmas(paths, astlint,
+                              conc if not args.concurrency else conc)
+        for msg in stale:
+            print(msg)
+        if stale:
+            print("%d stale pragma(s)" % len(stale))
+    if args.check and (n or stale):
         return 1
     return 0
 

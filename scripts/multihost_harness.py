@@ -994,6 +994,53 @@ def payload_codec_pod(pid):
     return res
 
 
+def payload_sched_verify(pid):
+    """The dispatch-schedule verifier's acceptance payload (ISSUE 17):
+
+    * matched phase — every process runs the SAME streamed pipeline,
+      then ``multihost.verify_schedule`` must agree bit-identically on
+      the digest;
+    * skew phase — ``BOLT_CHAOS=mh.sched.skew:1:raise`` armed on ONE
+      process makes it enqueue an extra LOCAL single-device program
+      (no cross-process collective, so nothing can hang — only the
+      schedules diverge); the next verify must raise a pointed
+      :class:`ScheduleDivergenceError` on every process, naming the
+      first divergent slot instead of wedging in gloo."""
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import _chaos, engine
+    from bolt_tpu.parallel import multihost
+    engine.schedule_log_arm(True)
+    n, vdim = 32, 4
+    x = _crafted(n, vdim)
+    mesh = _mesh()
+    res = {"pid": pid, "nproc": multihost.process_count()}
+    b = bolt.fromcallback(lambda idx: x[idx], (n, vdim), mesh,
+                          dtype=np.float32, chunks=4,
+                          per_process=True).map(ADD1).sum().cache()
+    res["sum"] = float(np.asarray(_value(b)).sum())
+    res["digest_matched"] = multihost.verify_schedule("matched")
+    res["count_matched"] = engine.schedule_digest()[0]
+    try:
+        _chaos.hit("mh.sched.skew")
+        res["skewed"] = False
+    except _chaos.ChaosError:
+        res["skewed"] = True
+        import jax
+        from jax.sharding import Mesh
+        lmesh = Mesh(np.asarray(jax.local_devices()[:1]), ("k",))
+        bolt.array(_crafted(8, vdim), context=lmesh).map(ADD1) \
+            .sum().cache()
+    try:
+        multihost.verify_schedule("skewed", timeout=30.0)
+        res["divergence"] = None
+    except multihost.ScheduleDivergenceError as exc:
+        res["divergence"] = {"peer": exc.peer, "index": exc.index,
+                             "local_key": exc.local_key,
+                             "message": str(exc)[:400]}
+    return res
+
+
 PAYLOADS = {
     "stream_parity": payload_stream_parity,
     "single_ref": payload_single_ref,
@@ -1004,6 +1051,7 @@ PAYLOADS = {
     "serve_pod": payload_serve_pod,
     "supervise": payload_supervise,
     "precollective": payload_precollective,
+    "sched_verify": payload_sched_verify,
 }
 
 

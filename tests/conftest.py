@@ -32,18 +32,73 @@ if not CHIP_GATE:
 import pytest  # noqa: E402
 
 
+# the concurrency suites: every test in these modules runs under the
+# armed lockdep witness (bolt_tpu/_lockdep) via the autouse fixture
+# below — one observed rank inversion, self-deadlock or
+# dispatch-under-lock anywhere in them fails the test that did it
+_LOCKDEP_SUITES = frozenset({
+    "test_serve", "test_serve_batching", "test_stream",
+    "test_supervisor", "test_multistat", "test_parity_locks",
+    "test_podwatch",
+})
+
+
 def pytest_collection_modifyitems(config, items):
     """Under the chip gate the CPU-mesh/x64 assumptions of every other
     test are void — deselect everything unmarked so a bare
     ``BOLT_TEST_CHIP=1 pytest`` is safe without the wrapper script's
-    ``-m chip`` flag."""
+    ``-m chip`` flag.  Outside it, tag the concurrency suites with the
+    ``lockdep`` marker so they run under the armed witness (and are
+    selectable standalone via ``pytest -m lockdep``)."""
     if not CHIP_GATE:
+        for item in items:
+            base = os.path.basename(item.nodeid.split("::", 1)[0])
+            if base[:-3] in _LOCKDEP_SUITES:
+                item.add_marker(pytest.mark.lockdep)
         return
     skip = pytest.mark.skip(
         reason="BOLT_TEST_CHIP gate runs only the -m chip subset")
     for item in items:
         if "chip" not in item.keywords:
             item.add_marker(skip)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _thread_census_gate():
+    """Hygiene gate (ISSUE 17): no bolt-owned worker thread may outlive
+    its test module.  A short drain window absorbs daemon workers that
+    were signalled to exit but not yet reaped when teardown returns."""
+    yield
+    import time
+    from bolt_tpu.obs import thread_census
+    census = thread_census()
+    deadline = time.monotonic() + 5.0
+    while census and time.monotonic() < deadline:
+        time.sleep(0.05)
+        census = thread_census()
+    assert census == {}, "module leaked worker threads: %s" % (census,)
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_witness(request):
+    """Arm the runtime lock-hierarchy witness around every ``lockdep``-
+    marked test and fail the test on any NEW violation it recorded —
+    the suites exercise the real thread pools, so a green run is an
+    empirical no-inversion certificate for the lock inventory."""
+    if "lockdep" not in request.keywords:
+        yield
+        return
+    from bolt_tpu import _lockdep
+    before = len(_lockdep.violations())
+    was_enabled = _lockdep.enabled()
+    _lockdep.enable()
+    try:
+        yield
+    finally:
+        if not was_enabled:
+            _lockdep.disable()
+    new = _lockdep.violations()[before:]
+    assert not new, "lockdep violations during test:\n" + "\n".join(new)
 
 
 @pytest.fixture(scope="session")

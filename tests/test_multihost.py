@@ -504,3 +504,56 @@ def test_codec_pod_local_shards_encode_and_fold(codec_pod):
         assert r["delta_bytes"] == r["raw_bytes"], r
         assert r["sidecar_refused"] is True, r
         assert r["leaked_spans"] == 0, r
+
+
+# ---------------------------------------------------------------------
+# the dispatch-schedule verifier on the live pod (ISSUE 17)
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sched_pod():
+    if not _HAS_GLOO:
+        pytest.skip("no CPU cross-process collective transport")
+    mh = _harness()
+    hb = tempfile.mkdtemp(prefix="bolt-sched-hb-")
+    try:
+        results, out, _ = mh.run_cluster(
+            "sched_verify", nproc=2, devs=1,
+            env={"BOLT_POD_HB_DIR": hb},
+            worker_env={1: {"BOLT_CHAOS": "mh.sched.skew:1:raise"}})
+        yield results
+        shutil.rmtree(out, ignore_errors=True)
+    finally:
+        shutil.rmtree(hb, ignore_errors=True)
+
+
+@needs_cluster
+def test_schedule_digests_match_across_pod(sched_pod):
+    """Matched schedules verify bit-identically: every process folded
+    the same program keys in the same order into the same digest."""
+    r0, r1 = sched_pod
+    assert r0["count_matched"] > 0
+    assert r0["count_matched"] == r1["count_matched"]
+    assert r0["digest_matched"] == r1["digest_matched"]
+    assert r0["sum"] == r1["sum"]
+
+
+@needs_cluster
+def test_schedule_skew_raises_pointed_divergence(sched_pod):
+    """A chaos-injected extra enqueue on ONE process turns the next
+    verify into a pointed ScheduleDivergenceError on EVERY process —
+    naming the diverging peer and the first divergent slot — instead
+    of a silent gloo hang."""
+    r0, r1 = sched_pod
+    assert r1["skewed"] is True and r0["skewed"] is False
+    assert r0["divergence"]["peer"] == 1
+    assert r1["divergence"]["peer"] == 0
+    for r in (r0, r1):
+        d = r["divergence"]
+        assert d is not None, r
+        assert "diverged" in d["message"]
+        # the skew was ONE extra program appended after the matched
+        # prefix: the first divergent slot is exactly the shared count
+        assert d["index"] == r["count_matched"]
+    # the skewed process's key log names the extra program it enqueued
+    assert r1["divergence"]["local_key"], r1["divergence"]
