@@ -76,6 +76,8 @@ SEAMS = (
     "stream.upload",          # uploader-pool / prefetch ingest hot path
     "stream.dispatch",        # consumer, before each slab dispatch
     "stream.fold",            # the final pairwise fold
+    "stream.shuffle",         # before each shuffle re-bucket dispatch
+    "stream.spill",           # before each spilled-bucket write
     "stream.checkpoint",      # checkpoint.stream_save entry
     "checkpoint.meta",        # between state write and meta rename
     "checkpoint.corrupt",     # flips bytes in a just-written state file

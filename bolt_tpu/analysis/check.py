@@ -433,6 +433,67 @@ def _note_codec(src, idx, diags, members=()):
              "passes, and the arbiter leases the wire bytes"))
 
 
+def _note_shuffle(src, stage, aval, split, mesh, idx, diags):
+    """``BLT017``: forecast the streamed shuffle (ISSUE 18) — the SAME
+    planner the executor runs (``parallel.shuffle.plan_shuffle`` fed by
+    ``stream.swap_budget()``/``spill_scope()``), so the forecast and
+    the dispatch-time resident/spill decision cannot drift.  INFO for a
+    servable plan; WARNING when the plan forecasts spill with no spill
+    directory configured (the executor will refuse pointedly) or when
+    the pod geometry refuses the collective outright."""
+    from bolt_tpu import stream as _stream
+    from bolt_tpu.parallel import shuffle as _shuffle
+    perm, new_split = stage[1], stage[2]
+    spill_dir, _ = _stream.spill_scope()
+    try:
+        plan = _shuffle.plan_shuffle(
+            tuple(aval.shape), np.dtype(aval.dtype), split, perm,
+            new_split, mesh, src.slab, _stream.swap_budget(), spill_dir)
+    except ValueError as exc:
+        diags.append(Diagnostic(
+            "BLT017", idx,
+            "the streamed shuffle refuses this swap — the run will "
+            "raise identically at dispatch: %s"
+            % str(exc).splitlines()[0], severity="warning",
+            hint="reshape the pipeline so the swap satisfies the pod "
+                 "geometry, or materialise first (toarray) and swap "
+                 "in memory"))
+        return
+    if not plan.resident and plan.sharded:
+        diags.append(Diagnostic(
+            "BLT017", idx,
+            plan.describe() + " — but disk spill is single-process "
+            "only: the multi-process executor will refuse this swap "
+            "at dispatch",
+            severity="warning",
+            hint="raise the arbiter budget so the re-keyed buckets "
+                 "stay resident, or materialise first (toarray) and "
+                 "swap in memory"))
+        return
+    if not plan.resident and plan.spill_dir is None:
+        diags.append(Diagnostic(
+            "BLT017", idx,
+            plan.describe() + " — but NO spill directory is "
+            "configured: the executor will refuse this swap at "
+            "dispatch rather than materialise silently",
+            severity="warning",
+            hint="wrap the run in bolt_tpu.stream.spill(dir=...) to "
+                 "license disk spill, or raise the arbiter budget so "
+                 "the re-keyed buckets stay resident"))
+        return
+    diags.append(Diagnostic(
+        "BLT017", idx, plan.describe(),
+        hint="phase 1 re-buckets each uploaded slab on device (one "
+             "all-to-all per slab on pods) and %s; phase 2 streams "
+             "the buckets through the standard slab machinery — "
+             "bit-identical to the materialised swap "
+             "(shuffle_bytes/spill_bytes engine counters)"
+             % ("keeps them resident in HBM under the arbiter lease"
+                if plan.resident
+                else "spills them codec-encoded to the fingerprint "
+                     "directory")))
+
+
 def _check_predicate(pred, vshape, vdtype, idx, diags):
     """Abstractly trace a filter predicate over one value block and emit
     BLT001 (trace failure) / BLT007 (non-scalar per record) — the ONE
@@ -946,6 +1007,12 @@ def _check_stream(arr, target, stages, diags):
                      "per-slab program; fix the callable's shape/dtype "
                      "contract"))
             break
+        if stage[0] == "swap":
+            # the shuffle forecast anchors on the PRE-swap geometry
+            # (the planner's input), then the walk adopts the swapped
+            # split for every later stage
+            _note_shuffle(src, stage, aval, walk_split, mesh, idx, diags)
+            walk_split = stage[2]
         old, new = np.dtype(aval.dtype), np.dtype(nxt.dtype)
         if new.itemsize > old.itemsize:
             diags.append(Diagnostic(

@@ -47,6 +47,9 @@ CODES = {
     "BLT016": ("info",
                "codec-encoded ingest: streamed slabs ship compressed "
                "and decode on device"),
+    "BLT017": ("info",
+               "streamed shuffle plan: the swap re-buckets slab by "
+               "slab, resident in HBM or spilled past the budget"),
 }
 
 SEVERITIES = ("error", "warning", "info")

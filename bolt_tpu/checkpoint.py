@@ -570,3 +570,167 @@ def stream_clear(path, multiprocess=None):
 def stream_pending(path):
     """Does ``path`` hold a resumable stream checkpoint?"""
     return os.path.exists(_smeta_path(path))
+
+
+# ---------------------------------------------------------------------------
+# shuffle spill slabs (ISSUE 18)
+#
+# When a streamed `swap` / re-axis shuffle forecasts a working set larger
+# than the device arbiter's budget, phase 1 spills each re-keyed bucket
+# to disk and phase 2 streams the buckets back as a fresh source.  The
+# on-disk format reuses this module's contract: ATOMIC tmp+rename per
+# file, self-describing payloads (codec name + dtype + shape + global
+# row offset ride inside), and a fingerprint-named working directory so
+# a resumed run can only ever adopt ITS OWN spill — a different
+# pipeline's leftovers hash to a different directory and are invisible.
+#
+# Integer/bool buckets are dict-encoded when the slab's cardinality
+# allows (codec "dict": uint8 indices + 256-entry dictionary — 1/8 the
+# bytes of an int64 key column); anything else is stored raw.  The
+# fallback is per-BUCKET and recorded in the file, so mixed-cardinality
+# datasets just work and decode never guesses.
+#
+# Completion is tracked per SLAB (a slab is done only after every one of
+# its buckets landed) in a per-process manifest, giving the kill -9
+# resume point: a single-process run skips completed slabs; pod runs
+# ignore manifests entirely and re-run phase 1 whole (per-process
+# manifests can disagree after an asymmetric kill, and a disagreeing
+# slab schedule would deadlock the all-to-all rendezvous — atomic
+# overwrite keeps the re-run correct).
+# ---------------------------------------------------------------------------
+
+def _spill_root(path, fingerprint):
+    h = hashlib.sha256(repr(fingerprint).encode()).hexdigest()[:16]
+    return os.path.join(path, "bolt-spill-%s" % h)
+
+
+def _spill_file(path, fingerprint, slab_i, bucket_i):
+    return os.path.join(
+        _spill_root(path, fingerprint),
+        "slab%05d.bucket%05d.p%d.npz"
+        % (int(slab_i), int(bucket_i), _multihost.process_index()))
+
+
+def _spill_manifest_path(path, fingerprint):
+    return os.path.join(_spill_root(path, fingerprint),
+                        "manifest.p%d.json" % _multihost.process_index())
+
+
+def spill_save(path, fingerprint, slab_i, bucket_i, block, row0):
+    """Persist one re-keyed shuffle bucket (this process's rows of
+    bucket ``bucket_i`` produced from input slab ``slab_i``) atomically
+    under ``path``'s fingerprint directory.  ``row0`` is the bucket's
+    GLOBAL output row offset — phase 2 reassembles buckets by it
+    without re-deriving the plan.  Returns the bytes written (the
+    ``spill_bytes`` tally).  Integer/bool blocks try the "dict" codec
+    first and fall back to raw when the slab's cardinality exceeds the
+    dictionary (the fallback is recorded in the file — decode never
+    guesses)."""
+    block = np.ascontiguousarray(block)
+    codec_name = ""
+    wire, sides = block, ()
+    if np.issubdtype(block.dtype, np.integer) \
+            or block.dtype == np.dtype(np.bool_):
+        from bolt_tpu.tpu import codec as _codec
+        try:
+            wire, sides = _codec.get("dict").encode(block, delta_ok=False)
+            codec_name = "dict"
+        except ValueError:        # > 256 distinct values: store raw
+            wire, sides, codec_name = block, (), ""
+    root = _spill_root(path, fingerprint)
+    os.makedirs(root, exist_ok=True)
+    payload = {"wire": wire,
+               "row0": np.asarray(int(row0), dtype=np.int64),
+               "shape": np.asarray(block.shape, dtype=np.int64),
+               "dtype": np.asarray(str(block.dtype)),
+               "codec": np.asarray(codec_name),
+               "nside": np.asarray(len(sides), dtype=np.int64)}
+    for i, s in enumerate(sides):
+        payload["side%d" % i] = np.asarray(s)
+    fpath = _spill_file(path, fingerprint, slab_i, bucket_i)
+    tmp = fpath + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, fpath)
+    return os.path.getsize(fpath)
+
+
+def spill_load(path, fingerprint, slab_i, bucket_i):
+    """Read one spilled bucket back as ``(host block, row0)`` — the
+    inverse of :func:`spill_save`, host-side decode included.  A
+    missing or torn file raises :class:`CheckpointCorruptError`
+    pointedly (phase 2 only reads slabs the manifest marked done, so a
+    hole here is rot or an outside deletion, not a normal resume)."""
+    fpath = _spill_file(path, fingerprint, slab_i, bucket_i)
+    try:
+        with np.load(fpath, allow_pickle=False) as z:
+            wire = z["wire"]
+            row0 = int(z["row0"])
+            dtype = np.dtype(str(z["dtype"]))
+            codec_name = str(z["codec"])
+            shape = tuple(int(n) for n in z["shape"])
+            sides = tuple(z["side%d" % i]
+                          for i in range(int(z["nside"])))
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            "spill bucket missing: %s — the manifest marked slab %d "
+            "done but its bucket %d file is gone (deleted or never "
+            "fenced); clear the spill directory "
+            "(bolt_tpu.checkpoint.spill_clear) and re-run"
+            % (fpath, int(slab_i), int(bucket_i)))
+    except (ValueError, OSError, KeyError) as exc:
+        raise CheckpointCorruptError(
+            "spill bucket unreadable: %s (%s) — torn write or storage "
+            "rot; clear the spill directory "
+            "(bolt_tpu.checkpoint.spill_clear) and re-run"
+            % (fpath, exc))
+    if codec_name:
+        from bolt_tpu.tpu import codec as _codec
+        block = np.asarray(_codec.get(codec_name).decode(
+            wire, sides, dtype, delta_ok=False))
+    else:
+        block = wire.astype(dtype, copy=False)
+    return block.reshape(shape), row0
+
+
+def spill_slab_done(path, fingerprint, slab_i):
+    """Mark input slab ``slab_i`` complete in this process's spill
+    manifest — called ONLY after every bucket of the slab landed, so
+    the manifest's claim is the fence (a kill between bucket writes
+    leaves the slab unmarked and the resume re-runs it; the atomic
+    per-bucket overwrite makes that idempotent)."""
+    done = sorted(spill_manifest(path, fingerprint) | {int(slab_i)})
+    mpath = _spill_manifest_path(path, fingerprint)
+    os.makedirs(os.path.dirname(mpath), exist_ok=True)
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"done": done}, f)
+    os.replace(tmp, mpath)
+
+
+def spill_manifest(path, fingerprint):
+    """The set of input slabs this process has fully spilled for
+    ``fingerprint`` under ``path`` — empty when no spill exists (a
+    different fingerprint hashes to a different directory, so a stale
+    spill can never leak into a changed pipeline)."""
+    try:
+        with open(_spill_manifest_path(path, fingerprint)) as f:
+            return set(int(s) for s in json.load(f)["done"])
+    except (FileNotFoundError, ValueError, KeyError):
+        return set()
+
+
+def spill_pending(path):
+    """Does ``path`` hold any shuffle spill working directory?"""
+    return bool(glob.glob(os.path.join(path, "bolt-spill-*")))
+
+
+def spill_clear(path):
+    """Remove every shuffle spill working directory under ``path`` (the
+    success path: a completed shuffle's phase 2 owns its buckets only
+    until the output is consumed — the ``bench_all --check`` gate
+    asserts a cleared directory holds no ``bolt-spill-*`` residue,
+    half-written ``.tmp`` droppings included)."""
+    import shutil
+    for d in glob.glob(os.path.join(path, "bolt-spill-*")):
+        shutil.rmtree(d, ignore_errors=True)

@@ -171,6 +171,13 @@ _SCHEMA = {
                                   # (summed across uploader workers)
     "codec_bytes_raw": 0,         # pre-encode logical slab bytes
     "codec_bytes_wire": 0,        # post-encode bytes actually shipped
+    # the streaming shuffle (ISSUE 18): bytes moved through phase 1's
+    # re-bucket dispatches (all-to-all included), bytes spilled to the
+    # fingerprint directory when the plan exceeded the arbiter budget,
+    # and the whole phase-1 wall (upload + re-bucket + spill).
+    "shuffle_bytes": 0,
+    "spill_bytes": 0,
+    "shuffle_seconds": 0.0,
 }
 
 _COUNTERS = _metrics.registry().group("engine", _SCHEMA)
@@ -532,6 +539,22 @@ def record_codec(raw_bytes, wire_bytes, seconds):
     _COUNTERS.update(codec_bytes_raw=int(raw_bytes),
                      codec_bytes_wire=int(wire_bytes),
                      codec_encode_seconds=seconds)
+
+
+def record_shuffle(nbytes, seconds):
+    """Tally one streamed shuffle's phase 1 (bolt_tpu.stream's swap
+    resolver): ``nbytes`` moved through the re-bucket programs and the
+    phase's wall clock.  One update per shuffle, applied at the end —
+    a snapshot never sees a half-accounted phase.  The timeline carries
+    it as the ``stream.shuffle`` span."""
+    _COUNTERS.update(shuffle_bytes=int(nbytes), shuffle_seconds=seconds)
+
+
+def record_spill(nbytes):
+    """Tally one spilled shuffle bucket's wire bytes
+    (checkpoint.spill_save's return — dict-encoded when the slab's
+    cardinality allowed, raw otherwise)."""
+    _COUNTERS.update(spill_bytes=int(nbytes))
 
 
 def record_stream_retry():

@@ -242,6 +242,50 @@ def reform(coordinator_address, num_processes, process_id=None,
     return int(process_id)
 
 
+def heal_backend_init():
+    """Recover from a POISONED CPU-backend bring-up on the live
+    coordination service.
+
+    The distributed CPU backend's topology exchange inserts
+    ``cpu:local_topology/cpu/<pid>`` into the coordination KV store and
+    then waits for every peer's key.  When the exchange FAILS partway —
+    a peer died, reformed to a newer plan generation, or simply had not
+    retried yet within the 2-minute window — this process's own key
+    stays behind, and every later rebuild against the same service dies
+    instantly with ``ALREADY_EXISTS`` on its own insert.  Worse, the
+    poison is symmetric: a peer in the same state can never re-publish
+    either, so each side's exchange waits forever on a key the other
+    side is barred from inserting — the wedge is self-sustaining until
+    someone deletes the stale keys.
+
+    This helper deletes THIS process's stale topology key (plus the
+    best-effort composed global-topology key) and drops the failed
+    backend state, so the next backend query re-runs the exchange
+    cleanly.  Safe by construction: it only ever runs after a FAILED
+    bring-up (no healthy backend exists to invalidate), and each
+    process deletes only the key it owns.  Returns ``True`` when a
+    live client was found to heal against."""
+    client = _compat.distributed_client()
+    if client is None:
+        return False
+    st = _compat._distributed_state()
+    pid = int(getattr(st, "process_id", 0) or 0)
+    for key in ("cpu:local_topology/cpu/%d" % pid, "cpu:global_topology"):
+        try:
+            client.key_value_delete(key)
+        except Exception:             # noqa: BLE001 — absent key / dead
+            pass                      # store: nothing to heal there
+    try:
+        _compat.clear_backends()
+    except Exception:                 # noqa: BLE001 — no reset hook on
+        pass                          # this jax: the retry still re-runs
+    from bolt_tpu import engine as _engine
+    _engine.clear()
+    from bolt_tpu.obs import trace as _obs
+    _obs.event("multihost.backend_heal", process_id=pid)
+    return True
+
+
 # ---------------------------------------------------------------------
 # topology queries (the BLT110 home)
 # ---------------------------------------------------------------------

@@ -1,0 +1,318 @@
+"""The out-of-core shuffle planner: slab-wise re-axis for streamed
+``swap`` (ISSUE 18).
+
+``swap(kaxes, vaxes)`` is THE signature Bolt operation (the reference's
+chunk → Spark shuffle → unchunk, SURVEY §3.3) — and the one core op a
+streamed source could not reach without materialising fully.  This
+module plans the two-phase pipeline that closes the gap:
+
+* **phase 1 (re-bucket)**: every input slab streams up through the
+  normal uploader path, and ONE compiled program per slab applies the
+  pre-swap stage chain and the swap's transpose, producing that slab's
+  contribution to the output — the full new-key extent, with the
+  slab's input records along the axis the old record axis landed on
+  (``j0 = perm.index(0)``).  On a pod the program runs under
+  ``shard_map`` with an explicit ``lax.all_to_all`` (split the new
+  record axis, concatenate at ``j0``), so each slab costs exactly one
+  collective; single-process the transpose plus a sharding constraint
+  lets GSPMD insert the local permute.
+* **phase 2 (re-assemble)**: transposed slabs either stay RESIDENT
+  (concatenated along ``j0`` into the swapped array when the output
+  fits the budget) or SPILL to encoded bucket files — ``out_block``
+  new-key records per bucket — which a fresh callback
+  :class:`~bolt_tpu.stream.StreamSource` then streams through the SAME
+  slab-program machinery as any other source (Spark's shuffle-spill
+  reincarnated on the donation ring).
+
+Parity is by construction: phase 1 traces the SAME
+``jnp.transpose(perm)`` expression the materialised ``_do_swap``
+compiles and the SAME ``_stage_apply`` bodies the materialised replay
+uses, and transpose/split/concatenate are pure data movement — so a
+streamed swap is bit-identical to the materialised one, resident or
+spilled, single-process or pod.
+
+The planner (:func:`plan_shuffle`) is consulted both by the executor
+(``stream.resolve_swaps``) and abstractly by ``analysis.check`` (the
+BLT017 forecast), so the forecast and the measured decision cannot
+drift: both read the same resident/spill rule off the same budget.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bolt_tpu import engine as _engine
+from bolt_tpu.parallel import multihost as _multihost
+from bolt_tpu.parallel import sharding as _sharding
+from bolt_tpu.utils import prod
+
+
+class ShufflePlan:
+    """The static description of one streamed-swap resolution.
+
+    ``resident`` is the phase-2 decision: keep every transposed slab in
+    HBM and concatenate (True), or spill encoded bucket files and
+    re-stream them (False).  ``alltoall_bytes`` is the planner's
+    cross-device traffic model: the bytes that must cross device
+    boundaries during phase 1 (0 when the record axis stays leading —
+    a pure local permute)."""
+
+    __slots__ = ("in_shape", "dtype", "split", "perm", "new_split",
+                 "out_shape", "j0", "slab", "nslabs", "out_block",
+                 "nbuckets", "total_bytes", "slab_bytes", "budget",
+                 "resident", "spill_dir", "alltoall_bytes", "sharded")
+
+    def __init__(self, **kw):
+        for name in self.__slots__:
+            setattr(self, name, kw[name])
+
+    def describe(self):
+        """One-line human summary (the BLT017 message body)."""
+        mb = 1024.0 * 1024.0
+        mode = "resident" if self.resident else (
+            "spill to %s" % (self.spill_dir or "<no spill dir>"))
+        return ("shuffle plan: %d slab%s -> %s (%.1f MiB working set, "
+                "budget %s, %d bucket%s x %d records, all-to-all "
+                "~%.1f MiB)"
+                % (self.nslabs, "s" if self.nslabs != 1 else "", mode,
+                   self.total_bytes / mb,
+                   ("%.1f MiB" % (self.budget / mb))
+                   if self.budget is not None else "unbounded",
+                   self.nbuckets, "s" if self.nbuckets != 1 else "",
+                   self.out_block, self.alltoall_bytes / mb))
+
+
+def _axis0_device_width(mesh, shape, split):
+    """How many devices shard the LEADING key axis of ``shape`` under
+    the key sharding — the divisor phase-2 bucket extents must honour
+    so bucket slabs reshard cleanly."""
+    if mesh is None:
+        return 1
+    spec = _sharding.key_spec(mesh, tuple(shape), split)
+    names = _sharding.spec_names(spec[0] if len(spec) else None)
+    return prod([mesh.shape[n] for n in names]) if names else 1
+
+
+def _pick_out_block(extent, target_rows, mult):
+    """Largest divisor of ``extent`` that is a multiple of ``mult`` and
+    no larger than ``target_rows`` — the phase-2 bucket extent.  Falls
+    back to the SMALLEST valid divisor when nothing fits under the
+    target (better one oversized bucket than a refused plan); ``None``
+    when no divisor honours ``mult`` at all."""
+    mult = max(1, int(mult))
+    divisors = [d for d in range(1, extent + 1)
+                if extent % d == 0 and d % mult == 0]
+    if not divisors:
+        return None
+    under = [d for d in divisors if d <= max(target_rows, 1)]
+    return under[-1] if under else divisors[0]
+
+
+def plan_shuffle(staged_shape, dtype, split, perm, new_split, mesh,
+                 slab, budget, spill_dir):
+    """Plan one streamed-swap resolution over the POST-pre-stage
+    geometry.
+
+    ``staged_shape``/``dtype``/``split`` describe the stream AFTER the
+    stages recorded before the swap (the value the swap's transpose
+    actually sees); ``perm``/``new_split`` are the swap's permutation
+    exactly as ``tpu/array.py :: _do_swap`` builds them; ``slab`` is
+    the input records per slab; ``budget`` the resident ceiling in
+    bytes (``None`` = unbounded → always resident); ``spill_dir``
+    where bucket files would land.  Raises the pointed pod-geometry
+    errors HERE, before any thread starts, mirroring BLT012."""
+    staged_shape = tuple(int(s) for s in staged_shape)
+    perm = tuple(int(p) for p in perm)
+    out_shape = tuple(staged_shape[p] for p in perm)
+    j0 = perm.index(0)
+    itemsize = np.dtype(dtype).itemsize
+    total_bytes = prod(out_shape) * itemsize
+    n = staged_shape[0]
+    nslabs = max(1, -(-n // max(slab, 1)))
+    slab_bytes = min(slab, n) * prod(staged_shape[1:]) * itemsize
+    sharded = _multihost.mesh_process_count(mesh) > 1
+
+    # phase-2 bucket extent along the NEW leading key axis: must divide
+    # the extent (buckets tile it exactly), honour the output key
+    # sharding's device width (bucket slabs reshard cleanly — the
+    # BLT012 analog), and on pods divide the per-process range (each
+    # bucket wholly owned by ONE process, so spill files never cross
+    # host boundaries)
+    out_n = out_shape[0]
+    dwidth = _axis0_device_width(mesh, out_shape, new_split)
+    extent = out_n
+    if sharded:
+        nproc = _multihost.mesh_process_count(mesh)
+        if out_n % nproc != 0:
+            raise ValueError(
+                "streamed swap on a %d-process pod needs the new "
+                "leading key extent (%d) divisible by the process "
+                "count — repartition or materialise the swap instead"
+                % (nproc, out_n))
+        extent = out_n // nproc
+    target = max(1, (slab_bytes // max(
+        prod(out_shape[1:]) * itemsize, 1)) or 1)
+    out_block = _pick_out_block(extent, target, dwidth)
+    if out_block is None:
+        # nothing divides cleanly: fall back to whole-extent buckets
+        out_block = extent
+    nbuckets = out_n // out_block
+
+    # the all-to-all traffic model: when the record axis stays leading
+    # (perm[0] == 0) every record keeps its device and nothing crosses;
+    # otherwise each device keeps 1/d of what it holds and ships the
+    # rest — the standard all-to-all volume over the d devices that
+    # shard the input record axis
+    d_in = _axis0_device_width(mesh, staged_shape, split)
+    alltoall_bytes = 0 if perm[0] == 0 or d_in <= 1 else int(
+        round(total_bytes * (d_in - 1) / d_in))
+
+    resident = budget is None or total_bytes + slab_bytes <= budget
+    return ShufflePlan(
+        in_shape=staged_shape, dtype=np.dtype(dtype), split=int(split),
+        perm=perm, new_split=int(new_split), out_shape=out_shape, j0=j0,
+        slab=int(slab), nslabs=int(nslabs), out_block=int(out_block),
+        nbuckets=int(nbuckets), total_bytes=int(total_bytes),
+        slab_bytes=int(slab_bytes),
+        budget=None if budget is None else int(budget),
+        resident=bool(resident), spill_dir=spill_dir,
+        alltoall_bytes=int(alltoall_bytes), sharded=bool(sharded))
+
+
+def _pod_axes_or_refuse(mesh, slab_shape, split, perm, out_slab_shape,
+                        new_split):
+    """The pod re-bucket geometry check: the explicit ``all_to_all``
+    form needs the input record axis's mesh axes to be exactly the
+    ones the OUTPUT leading key axis shards over (the collective splits
+    the new record extent over the same devices it gathers the old one
+    from), and the new leading axis must come from a REPLICATED value
+    axis (its full extent is local).  Returns the mesh-axis name tuple;
+    raises the pointed refusal otherwise."""
+    in_spec = _sharding.key_spec(mesh, slab_shape, split)
+    axes_in = _sharding.spec_names(in_spec[0] if len(in_spec) else None)
+    out_spec = _sharding.key_spec(mesh, out_slab_shape, new_split)
+    axes_out = _sharding.spec_names(out_spec[0] if len(out_spec)
+                                    else None)
+    if perm[0] == 0:
+        return ()                     # no cross-device movement
+    if perm[0] < split:
+        raise ValueError(
+            "streamed swap on a pod needs the new leading key axis to "
+            "come from a value axis or stay the record axis; key axis "
+            "%d moving to the front has per-process layout this "
+            "executor does not reshard — materialise the swap instead"
+            % (perm[0],))
+    if axes_in != axes_out:
+        raise ValueError(
+            "streamed swap on a pod needs the output key sharding to "
+            "reuse the input record axis's mesh axes (got %r -> %r); "
+            "materialise the swap instead" % (axes_in, axes_out))
+    return axes_in
+
+
+def rebucket_program(plan, pre_stages, mesh, codec_obj, raw_dtype,
+                     raw_slab_shape, delta_ok):
+    """The ONE compiled phase-1 program each input slab runs: fused
+    codec decode (when streaming rode a codec), the pre-swap stage
+    chain, and the swap's transpose — the EXACT expression the
+    materialised ``swap`` compiles, so parity holds by construction.
+
+    ``raw_slab_shape`` is the UPLOADED slab's shape (wire dtype under a
+    codec); the program's output is that slab's transposed block: the
+    full new-key extent with the slab's records at axis ``plan.j0``,
+    constrained to the output key sharding.  On pods the body runs
+    under ``shard_map`` with ONE explicit ``lax.all_to_all`` per slab
+    (``split_axis=0`` of the new layout, ``concat_axis=j0``, tiled) —
+    the TPU-native form of the reference's cluster-wide shuffle.
+    Engine-cached per (stages, slab geometry, perm, codec, topology):
+    uniform slabs compile exactly once per variant per process."""
+    split = plan.split
+    perm = plan.perm
+    j0 = plan.j0
+    slab_rows = raw_slab_shape[0]
+    out_slab_shape = tuple(
+        slab_rows if i == j0 else plan.out_shape[i]
+        for i in range(len(plan.out_shape)))
+    key = ("stream-shuffle", pre_stages, tuple(raw_slab_shape),
+           str(raw_dtype), split, perm, plan.new_split, mesh,
+           _multihost.topology_token() if plan.sharded else None,
+           codec_obj.name if codec_obj is not None else None)
+
+    def build():
+        from bolt_tpu.stream import _stage_apply
+        from bolt_tpu.tpu.array import _constrain
+
+        def body(data):
+            if codec_obj is None:
+                x = data
+            elif codec_obj.sidecar:
+                x = codec_obj.decode(data[0], data[1:], raw_dtype,
+                                     delta_ok)
+            else:
+                x = codec_obj.decode(data, (), raw_dtype, delta_ok)
+            for stg in pre_stages:
+                x = _stage_apply(stg, split, x)
+            return jnp.transpose(x, perm)
+
+        if not plan.sharded:
+            def run(data):
+                return _constrain(body(data), mesh, plan.new_split)
+            return jax.jit(run, donate_argnums=(0,))
+
+        from jax.sharding import PartitionSpec
+        from bolt_tpu import _compat
+        from bolt_tpu.parallel.sharding import key_spec
+        staged_slab = tuple(
+            slab_rows if i == 0 else plan.in_shape[i]
+            for i in range(len(plan.in_shape)))
+        axes = _pod_axes_or_refuse(mesh, staged_slab, split, perm,
+                                   out_slab_shape, plan.new_split)
+
+        def shard_body(data):
+            y = body(data)
+            if axes:
+                # one collective per slab: split the (locally full) new
+                # record axis over the devices that held the old one,
+                # concatenating each device's incoming pieces at j0 —
+                # device order equals global record order, so the glued
+                # global equals the global transpose bit-for-bit
+                for name in axes:
+                    y = jax.lax.all_to_all(y, name, split_axis=0,
+                                           concat_axis=j0, tiled=True)
+            return y
+
+        in_specs = key_spec(mesh, staged_slab, split)
+        out_entries = [None] * len(out_slab_shape)
+        out_entries[0] = (axes[0] if len(axes) == 1 else tuple(axes)) \
+            if axes else None
+        if not axes:
+            # record axis stays leading: its sharding is unchanged
+            out_entries[j0] = in_specs[0] if len(in_specs) else None
+        body_sm = _compat.shard_map(
+            shard_body, mesh, in_specs=in_specs,
+            out_specs=PartitionSpec(*out_entries), check_vma=False)
+        return jax.jit(body_sm, donate_argnums=(0,))
+
+    return _engine.get(key, build)
+
+
+def concat_program(plan, part_shapes, mesh):
+    """Glue phase-1 transposed slabs into the RESIDENT swapped array:
+    one concatenate along ``j0``, inputs donated (the parts are
+    consumed — at HBM-filling sizes the parts and the result cannot
+    coexist twice), output constrained to the new key sharding."""
+    key = ("stream-shuffle-concat", tuple(part_shapes), str(plan.dtype),
+           plan.j0, plan.new_split, mesh,
+           _multihost.topology_token() if plan.sharded else None)
+
+    def build():
+        from bolt_tpu.tpu.array import _constrain
+
+        def run(*parts):
+            out = parts[0] if len(parts) == 1 \
+                else jnp.concatenate(parts, axis=plan.j0)
+            return _constrain(out, mesh, plan.new_split)
+        return jax.jit(run, donate_argnums=tuple(range(len(part_shapes))))
+
+    return _engine.get(key, build)

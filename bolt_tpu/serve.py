@@ -1007,7 +1007,20 @@ class Server:
                     _clock() - fut.submitted_s > deadline
                 allowed = attempt < nretry and not expired \
                     and not self._cancel.is_set()
-                if allowed and isinstance(exc, PeerLostError):
+                poisoned_backend = (
+                    isinstance(exc, RuntimeError)
+                    and "Unable to initialize backend" in str(exc))
+                if allowed and poisoned_backend:
+                    # a failed topology exchange leaves this process's
+                    # own KV key behind, so a verbatim re-attempt dies
+                    # instantly on ALREADY_EXISTS (and starves every
+                    # peer waiting on a fresh insert) — purge the stale
+                    # keys first so the retry can actually bring the
+                    # backend up (multihost.heal_backend_init)
+                    from bolt_tpu.parallel import multihost as _mh
+                    _mh.heal_backend_init()
+                if allowed and (isinstance(exc, PeerLostError)
+                                or poisoned_backend):
                     # a pod outage IS retryable (the whole point of
                     # retries= under serving) — but only once the pod
                     # reforms: hold the re-attempt behind the admission

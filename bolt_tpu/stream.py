@@ -396,6 +396,66 @@ def resumable(dir, every=None):
         st.pop()
 
 
+# out-of-core shuffle spill (ISSUE 18): the process default spill
+# directory for streamed-swap resolutions whose output exceeds the
+# resident budget; None = no spill dir (a spill-forecast resolution
+# then refuses pointedly — BLT017 warns ahead of time).
+_SPILL_DIR = os.environ.get("BOLT_STREAM_SPILL_DIR") or None
+
+
+@contextlib.contextmanager
+def spill(dir=None, budget=None):
+    """Scope the out-of-core shuffle's spill policy::
+
+        with bolt_tpu.stream.spill("/scratch/shuffle", budget=1 << 30):
+            big.swap([1], [0]).sum()   # re-keyed buckets larger than
+                                       # 1 GiB spill to encoded files
+
+    ``dir`` is where spilled bucket files land (``None`` keeps the
+    ``BOLT_STREAM_SPILL_DIR`` default); ``budget`` caps the RESIDENT
+    working set in bytes (``None`` defers to the serving arbiter's
+    budget, else unbounded).  THREAD-LOCAL with the same stack
+    discipline as :func:`codec`/:func:`resumable`: one serve tenant's
+    spill policy must not redirect a neighbour's bucket files."""
+    st = _scope_stack("spill")
+    st.append((os.fspath(dir) if dir is not None else None,
+               int(budget) if budget is not None else None))
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def spill_scope():
+    """The calling thread's innermost :func:`spill` scope as
+    ``(dir, budget)`` — dir falling back to ``BOLT_STREAM_SPILL_DIR``,
+    budget ``None`` when unset."""
+    st = _scope_stack("spill")
+    if st:
+        d, b = st[-1]
+        return (d if d is not None else _SPILL_DIR), b
+    return _SPILL_DIR, None
+
+
+def swap_budget():
+    """The resident-working-set ceiling a streamed-swap resolution
+    plans against: the innermost :func:`spill` scope's explicit
+    ``budget``, else the ACTIVE serving arbiter's device budget, else
+    ``None`` (unbounded — always resident).  The checker's BLT017
+    forecast calls this same function, so the forecast and the
+    measured resident/spill decision cannot drift."""
+    _, b = spill_scope()
+    if b is not None:
+        return b
+    sv = sys.modules.get("bolt_tpu.serve")
+    if sv is None:
+        return None
+    arb = sv.device_arbiter()
+    if arb is None:
+        return None
+    return int(arb.budget)
+
+
 def pool_size(source):
     """The uploader-pool size a run over ``source`` will use: the
     calling thread's configured count (scope/env), else ``min(mesh
@@ -715,6 +775,14 @@ def _stage_apply(stage, split, x):
         from bolt_tpu.tpu.stack import _stack_map_body
         _, func, size, canon = stage
         return _stack_map_body(x, func, split, size, canon)
+    if kind == "swap":
+        # a swap stage is resolved by the two-phase shuffle executor
+        # (resolve_swaps) BEFORE any slab program compiles — it can
+        # never be applied slab-locally (the transpose crosses slab
+        # boundaries), so reaching here is an internal routing bug
+        raise RuntimeError(
+            "internal: a 'swap' stage reached slab execution without "
+            "being resolved — resolve_swaps must run first")
     raise ValueError("unknown stream stage %r" % (kind,))
 
 
@@ -731,6 +799,8 @@ def stage_label(stage):
         return "stacked(%d).map(%s)" % (stage[2], _name(stage[1]))
     if kind == "filter":
         return "filter(%s)" % _name(stage[1])
+    if kind == "swap":
+        return "swap(perm=%s, split=%d)" % (stage[1], stage[2])
     return kind
 
 
@@ -738,6 +808,10 @@ def stage_aval(stage, split, aval):
     """Abstract result of one stage (``jax.eval_shape`` through the real
     bodies; memoised, ZERO XLA compiles)."""
     from bolt_tpu.tpu.array import _cached_eval_shape
+    if stage[0] == "swap":
+        # pure axis permutation: the abstract result needs no trace
+        return jax.ShapeDtypeStruct(
+            tuple(aval.shape[p] for p in stage[1]), aval.dtype)
     key = ("stream-stage", stage, split, tuple(aval.shape),
            str(aval.dtype))
     return _cached_eval_shape(
@@ -778,6 +852,8 @@ def result_state(source):
             dynamic = True
             break                     # a filter is always the last stage
         aval = stage_aval(stage, split, aval)
+        if stage[0] == "swap":
+            split = stage[2]          # the swap re-draws the key|value cut
     n = prod(aval.shape[:split])
     vshape = tuple(aval.shape[split:])
     if dynamic:
@@ -856,7 +932,10 @@ def stacked_map_stage(view, func, dtype):
     src = b._stream
     st = result_state(src)
     size = int(view._size)
-    if st.dynamic or src.kind != "callback":
+    if st.dynamic or src.kind != "callback" or has_swap(src):
+        # a pending swap re-draws the record axis, so the slab/block
+        # alignment below would reason about the WRONG geometry —
+        # materialise instead (rare: stacked maps over re-keyed streams)
         return NotImplemented
     if _multihost.mesh_process_count(src.mesh) > 1:
         # a stacked func mixes records WITHIN its block; per-process
@@ -878,11 +957,66 @@ def stacked_map_stage(view, func, dtype):
     return StackedArray(out, size)
 
 
+def swap_stage(arr, perm, new_split):
+    """Record a ``swap`` (axis re-keying) on a stream-backed array —
+    LAZILY: the stage is a forecastable marker the two-phase shuffle
+    executor (:func:`resolve_swaps`) resolves at consumption, so
+    ``swap`` on a streamed source never materialises the input.
+    Returns NotImplemented (→ the materialised path) when the swap
+    cannot stream: a dynamic (post-filter) row count, a lossy ingest
+    codec (phase 1 decodes once; a later terminal would quantise
+    AGAIN, drifting from the materialised path), or a pod iterator
+    source (per-process bucket ownership needs random access)."""
+    from bolt_tpu.tpu.array import BoltArrayTPU
+    src = arr._stream
+    st = result_state(src)
+    if st.dynamic:
+        return NotImplemented
+    codec_obj = resolve_codec(src)
+    if codec_obj is not None and not codec_obj.lossless:
+        return NotImplemented
+    if _multihost.mesh_process_count(src.mesh) > 1 \
+            and src.kind != "callback":
+        return NotImplemented
+    out = BoltArrayTPU._streamed(
+        src.with_stage(("swap", tuple(int(p) for p in perm),
+                        int(new_split))))
+    return out
+
+
+def has_swap(source):
+    """Whether ``source`` carries an unresolved ``swap`` stage."""
+    return any(s[0] == "swap" for s in source.stages)
+
+
+def resolve_swaps(source):
+    """Resolve every pending ``swap`` stage of ``source`` through the
+    two-phase streaming shuffle (:func:`_resolve_one_swap`); returns a
+    ``BoltArrayTPU`` — CONCRETE when the last resolution was resident
+    (post-swap stages replayed through the normal materialised paths),
+    STREAM-BACKED over spilled bucket files when it spilled (post-swap
+    stages ride the new source lazily)."""
+    b = _resolve_one_swap(source)
+    while b._stream is not None and has_swap(b._stream):
+        b = _resolve_one_swap(b._stream)
+    return b
+
+
 # ---------------------------------------------------------------------
 # terminal routing
 # ---------------------------------------------------------------------
 
 _STAT_NAMES = ("sum", "mean", "var", "std")
+
+
+def _swap_resolved(arr):
+    """Resolve ``arr``'s pending swap stages IN PLACE (the adoption
+    mirrors ``_data``'s adopt-after-success): returns the post-swap
+    stream source to keep streaming over, or ``None`` when resolution
+    landed a concrete array — the materialised paths own the rest."""
+    res = resolve_swaps(arr._stream)
+    arr._adopt_resolved(res)
+    return arr._stream
 
 
 def maybe_stat(arr, axis, name, keepdims, ddof):
@@ -891,6 +1025,13 @@ def maybe_stat(arr, axis, name, keepdims, ddof):
     src = arr._stream
     if src is None or keepdims or name not in _STAT_NAMES:
         return NotImplemented
+    if has_swap(src):
+        # resolve the re-keying FIRST (two-phase shuffle): a resident
+        # resolution lands concrete data (the materialised stat path
+        # runs on it); a spilled one re-enters here over bucket files
+        src = _swap_resolved(arr)
+        if src is None:
+            return NotImplemented
     st = result_state(src)
     if st.n == 0:
         return NotImplemented           # empty: materialised path's rules
@@ -909,6 +1050,10 @@ def maybe_reduce(arr, func, axes, keepdims):
     src = arr._stream
     if src is None or keepdims:
         return NotImplemented
+    if has_swap(src):
+        src = _swap_resolved(arr)     # see maybe_stat
+        if src is None:
+            return NotImplemented
     st = result_state(src)
     if st.pred is not None or st.n == 0:
         return NotImplemented
@@ -1562,6 +1707,13 @@ def execute(arr, terminal, ddof=None, rfunc=None, specs=None,
         source = arr._stream
     if arr is not None:
         _engine.strict_guard(arr, "stream.%s()" % terminal)
+    if has_swap(source):
+        # every terminal door resolves swaps before entering here; a
+        # swap stage reaching the slab pipeline means a door was missed
+        raise RuntimeError(
+            "internal: execute() received a source with an unresolved "
+            "swap stage — the terminal doors resolve swaps first "
+            "(stream.resolve_swaps)")
     mesh = source.mesh
     split = source.split
     depth = prefetch_depth()
@@ -2378,8 +2530,25 @@ def materialize(source):
 
 
 def _materialize_spans(source):
+    if has_swap(source):
+        # the two-phase shuffle resolves the re-keying SLAB-WISE (the
+        # input never lives whole next to the output); a resident
+        # resolution is already the concrete replayed array, a spilled
+        # one materialises from its bucket files
+        b = resolve_swaps(source)
+        if b._stream is None:
+            return b
+        source = b._stream
     b = _materialize_base(source)
-    for stage in source.stages:
+    return _replay_stages(b, source.stages)
+
+
+def _replay_stages(b, stages):
+    """Replay recorded stream stages on a CONCRETE array through the
+    normal deferred/chunked/stacked/swap paths — the ONE replay used by
+    materialisation AND the resident shuffle's post-swap tail, so both
+    are bit-identical to having never streamed at all."""
+    for stage in stages:
         kind = stage[0]
         if kind == "map":
             b = b.map(stage[1], axis=tuple(range(b.split)))
@@ -2393,9 +2562,23 @@ def _materialize_spans(source):
             b = StackedArray(b, size).map(func, dtype=canon).unstack()
         elif kind == "filter":
             b = b.filter(stage[1], axis=tuple(range(b.split)))
+        elif kind == "swap":
+            b = _replay_swap(b, stage[1], stage[2])
         else:
             raise ValueError("unknown stream stage %r" % (kind,))
     return b
+
+
+def _replay_swap(b, perm, new_split):
+    """One recorded swap stage on a CONCRETE array: recover the
+    ``(kaxes, vaxes)`` the permutation was built from (``_do_swap``'s
+    construction, inverted) and run the standard materialised swap —
+    the streamed resolution and this replay therefore compile the SAME
+    expression."""
+    split = b.split
+    kaxes = [p for p in perm[new_split:] if p < split]
+    vaxes = [p - split for p in perm[:new_split] if p >= split]
+    return b._do_swap(kaxes, vaxes, True)
 
 
 def _materialize_base(source):
@@ -2445,3 +2628,470 @@ def _materialize_base(source):
         return BoltArrayTPU(data, source.split, source.mesh)
     data = transfer(host, sharding)
     return BoltArrayTPU(data, source.split, source.mesh)
+
+
+# ---------------------------------------------------------------------
+# the two-phase shuffle (ISSUE 18): streamed swap resolution
+# ---------------------------------------------------------------------
+
+def _shuffle_fingerprint(source, pre_stages, perm, new_split, out_block):
+    """Identity of one streamed-swap resolution for spill-manifest
+    matching — same discipline as :func:`_run_fingerprint`: geometry +
+    slab plan + the PRE-swap stage chain (callables by bytecode) + the
+    permutation itself, so a resume never adopts buckets cut by a
+    different pipeline."""
+    from bolt_tpu.utils import code_token
+    stages = "|".join(_stage_token(s) for s in pre_stages)
+    return ("bolt-stream-spill-v1",
+            "x".join(str(s) for s in source.shape), int(source.split),
+            str(source.dtype), int(source.slab), str(source.kind),
+            code_token(source.produce) if source.produce is not None
+            else "", stages, repr(tuple(perm)), int(new_split),
+            int(out_block))
+
+
+def _bucket_host(part, lo, hi):
+    """Host copy of rows ``[lo, hi)`` of a (possibly sharded) device
+    array — assembled from ADDRESSABLE shards only, so on a pod this is
+    exactly the rows this process owns under the output key sharding
+    (the spill files never carry another host's data)."""
+    out = np.empty((hi - lo,) + tuple(part.shape[1:]), part.dtype)
+    for s in part.addressable_shards:
+        idx = s.index
+        slo, shi, _ = idx[0].indices(part.shape[0])
+        a, b = max(slo, lo), min(shi, hi)
+        if a >= b:
+            continue
+        data = np.asarray(s.data)
+        out[(slice(a - lo, b - lo),) + tuple(idx[1:])] = \
+            data[a - slo:b - slo]
+    return out
+
+
+def _owned_buckets(part, out_block):
+    """Global bucket indices whose rows this process holds in ``part``
+    (sorted; single-process: all of them)."""
+    owned = set()
+    n = part.shape[0]
+    for s in part.addressable_shards:
+        slo, shi, _ = s.index[0].indices(n)
+        owned.update(range(slo // out_block, -(-shi // out_block)))
+    return sorted(owned)
+
+
+def _resolve_one_swap(source):
+    """Resolve the FIRST recorded swap of ``source`` via the two-phase
+    streaming shuffle (module docstring of
+    ``bolt_tpu.parallel.shuffle``): phase 1 streams input slabs through
+    the uploader pool and one re-bucket program each (all-to-all on
+    pods), phase 2 either concatenates RESIDENT parts into the swapped
+    array (post-swap stages replayed concretely) or returns a fresh
+    stream source over SPILLED bucket files carrying the post-swap
+    stages lazily.  Bit-identical to the materialised swap either way —
+    the re-bucket program traces the same transpose and the same stage
+    bodies."""
+    from bolt_tpu import checkpoint as _ckptlib
+    from bolt_tpu.parallel import shuffle as _shuffle
+    from bolt_tpu.tpu.array import BoltArrayTPU
+    from bolt_tpu.utils import chain_retry_step
+
+    cut = next(k for k, s in enumerate(source.stages)
+               if s[0] == "swap")
+    pre = source.stages[:cut]
+    _, perm, new_split = source.stages[cut]
+    post = source.stages[cut + 1:]
+    base = StreamSource(source.kind, source.produce, source.blocks,
+                        source.shape, source.split, source.dtype,
+                        source.mesh, source.slab, pre,
+                        ckpt=source.ckpt, codec=source.codec)
+    base._consumed = source._consumed
+    st = result_state(base)
+    mesh = source.mesh
+    split = source.split
+    spill_dir, _ = spill_scope()
+    plan = _shuffle.plan_shuffle(st.shape, st.dtype, st.split, perm,
+                                 new_split, mesh, base.slab,
+                                 swap_budget(), spill_dir)
+    if not plan.resident and spill_dir is None:
+        raise RuntimeError(
+            "streamed swap: the re-keyed working set (%.1f MiB) "
+            "exceeds the resident budget (%.1f MiB) and no spill "
+            "directory is configured — wrap the run in "
+            "bolt_tpu.stream.spill(dir) (or raise the budget); "
+            "analysis.check forecasts this as BLT017"
+            % (plan.total_bytes / 2**20, (plan.budget or 0) / 2**20))
+
+    codec_obj = resolve_codec(base)     # lossless or None (gated at
+    delta_ok = split < len(source.shape)  # swap_stage record time)
+    nretry = retry_limit()
+    depth = prefetch_depth()
+    nwork = pool_size(base)
+    mspec = None
+    if _multihost.mesh_process_count(mesh) > 1:
+        err = _multihost.slab_divisibility_error(
+            mesh, source.shape, split,
+            base.slab_ranges() if base.kind == "callback" else [])
+        if err is not None:
+            raise ValueError(err)       # BLT012 — check() forecasts it
+        err = _multihost.sidecar_codec_error(codec_obj, mesh)
+        if err is not None:
+            raise ValueError(err)
+        mspec = _multihost.local_slab_spec(base)
+        if not plan.resident:
+            # pod spill is refused, not attempted: phase 1 spills each
+            # bucket whole on the one process that owns its rows, but
+            # re-streaming those buckets as pod slabs needs every slab
+            # SPLIT across processes (the BLT012 divisibility
+            # contract) — two ownership models that cannot both hold.
+            raise RuntimeError(
+                "streamed swap: the re-keyed working set (%.1f MiB) "
+                "exceeds the resident budget (%.1f MiB) and disk "
+                "spill is single-process only — on a multi-process "
+                "mesh raise the arbiter budget so the buckets stay "
+                "resident, or materialise first (toarray) and swap "
+                "in memory; analysis.check forecasts this as BLT017"
+                % (plan.total_bytes / 2**20, (plan.budget or 0) / 2**20))
+
+    # spill-manifest resume (fingerprinted like stream checkpoints):
+    # slabs whose every bucket landed are skipped — their files are
+    # complete by the atomic-rename + mark-after-buckets discipline.
+    # Pod runs re-run phase 1 whole: per-process manifests can disagree
+    # after an asymmetric kill, and a disagreeing slab schedule would
+    # cross the all-to-all rendezvous (overwrites are atomic, so the
+    # re-run is correct, just unskipped).
+    fp = _shuffle_fingerprint(base, pre, perm, new_split,
+                              plan.out_block)
+    done = set()
+    if not plan.resident and base.kind == "callback" and mspec is None:
+        done = _ckptlib.spill_manifest(spill_dir, fp)
+        if done:
+            _engine.record_stream_resume()
+            _obs.event("stream.spill_resume", slabs=len(done))
+
+    ranges = base.slab_ranges() if base.kind == "callback" else None
+    jobs = None
+    if ranges is not None:
+        jobs = [(g, lo, hi) for g, (lo, hi) in enumerate(ranges)
+                if g not in done]
+    wire_item = (codec_obj.wire_dtype(source.dtype).itemsize
+                 if codec_obj is not None else source.dtype.itemsize)
+    tenant_tag = _engine.current_tenant()
+    lease = _tenant_lease()
+    ring = depth + nwork
+    permits = threading.Semaphore(ring)
+    stop = threading.Event()
+    rsq = _Reseq()
+    jobq = queue.Queue()
+    run_sp = _obs.begin("stream.shuffle", resident=plan.resident,
+                        slabs=plan.nslabs, buckets=plan.nbuckets,
+                        out_block=plan.out_block,
+                        alltoall_bytes=plan.alltoall_bytes)
+
+    def _encode_upload(block, slab_shape, axis0_off):
+        side = ()
+        if codec_obj is None:
+            payload = block
+        else:
+            payload, side = _encode_slab(codec_obj, block, delta_ok)
+        if mspec is None:
+            buf = _upload_slab(payload, mesh, split)
+        else:
+            buf = _upload_slab_mh(payload, mesh, split, slab_shape,
+                                  axis0_off)
+        if side:
+            buf = (buf,) + tuple(transfer(np.asarray(s)) for s in side)
+        return buf, int(payload.nbytes)
+
+    def _retry_or_raise(g, attempt, prev, exc, what):
+        allowed = attempt < nretry and not stop.is_set()
+        if allowed:
+            _engine.record_stream_retry()
+            _obs.event("stream.retry", slab=g, attempt=attempt + 1,
+                       error=type(exc).__name__)
+        return chain_retry_step(exc, prev, attempt, allowed,
+                                "%s %d" % (what, g),
+                                "stream.retries / BOLT_STREAM_RETRIES")
+
+    def dispenser():
+        try:
+            for j, (g, lo, hi) in enumerate(jobs):
+                if not _acquire(permits, stop):
+                    return
+                if lease is not None:
+                    nrec = hi - lo
+                    if mspec is not None:
+                        llo, lhi = mspec.local_range(lo, hi)
+                        nrec = lhi - llo
+                    if not lease.acquire(
+                            nrec * prod(source.shape[1:]) * wire_item,
+                            stop=stop):
+                        return
+                jobq.put((j, g, lo, hi))
+            rsq.finish(len(jobs))
+        except BaseException as exc:        # noqa: BLE001 — re-raised
+            rsq.fault(exc)                  # in the consumer
+        finally:
+            for _ in range(nwork):
+                jobq.put(None)
+
+    def worker(wid):
+        try:
+            with _engine.tenant(tenant_tag):
+                while True:
+                    job = jobq.get()
+                    if job is None or stop.is_set():
+                        return
+                    j, g, lo, hi = job
+                    attempt = 0
+                    prev = None
+                    while True:
+                        sp = _obs.begin("stream.ingest", parent=run_sp,
+                                        slab=g, worker=wid,
+                                        attempt=attempt)
+                        t0 = _clock()
+                        try:
+                            if mspec is None:
+                                block = base.produce_slab(lo, hi)
+                                buf, bnb = _encode_upload(
+                                    block, block.shape, 0)
+                            else:
+                                llo, lhi = mspec.local_range(lo, hi)
+                                block = base.produce_slab(llo, lhi)
+                                buf, bnb = _encode_upload(
+                                    block, mspec.slab_shape(lo, hi),
+                                    llo - lo)
+                            tsec = _clock() - t0
+                            if sp is not None:
+                                sp.set(bytes=bnb, lo=lo, hi=hi)
+                        except BaseException as exc:  # noqa: BLE001
+                            _obs.end(sp, error=type(exc).__name__)
+                            prev = _retry_or_raise(g, attempt, prev, exc,
+                                                   "shuffle slab")
+                            attempt += 1
+                            continue
+                        _obs.end(sp)
+                        break
+                    del block
+                    rsq.put(j, (g, buf, bnb, tsec))
+        except BaseException as exc:        # noqa: BLE001
+            rsq.fault(exc)
+
+    def prefetch():
+        # iterator sources: ONE sequential produce+upload thread; a
+        # one-shot iterable cannot resume, so `done` is always empty
+        j = 0
+        try:
+            with _engine.tenant(tenant_tag):
+                for g, (lo, hi, block) in enumerate(
+                        iter_record_blocks_indexed(base)):
+                    if stop.is_set():
+                        return
+                    if not _acquire(permits, stop):
+                        return
+                    sp = _obs.begin("stream.ingest", parent=run_sp,
+                                    slab=g)
+                    t0 = _clock()
+                    try:
+                        if lease is not None and not lease.acquire(
+                                int(block.size) * wire_item, stop=stop):
+                            return
+                        attempt = 0
+                        prev = None
+                        while True:
+                            try:
+                                buf, bnb = _encode_upload(
+                                    block, block.shape, 0)
+                                break
+                            except BaseException as exc:  # noqa: BLE001
+                                prev = _retry_or_raise(
+                                    g, attempt, prev, exc,
+                                    "shuffle slab")
+                                attempt += 1
+                        tsec = _clock() - t0
+                        if sp is not None:
+                            sp.set(bytes=bnb, lo=lo, hi=hi)
+                    finally:
+                        _obs.end(sp)
+                    del block
+                    rsq.put(j, (g, buf, bnb, tsec))
+                    j += 1
+                rsq.finish(j)
+        except BaseException as exc:        # noqa: BLE001
+            rsq.fault(exc)
+
+    def iter_record_blocks_indexed(src):
+        for lo, hi, block in src.slabs():
+            yield lo, hi, block
+
+    if base.kind == "callback":
+        lead = threading.Thread(target=dispenser,
+                                name="bolt-shuffle-prefetch",
+                                daemon=True)
+        pool = [threading.Thread(target=worker, args=(w,),
+                                 name="bolt-shuffle-upload-%d" % w,
+                                 daemon=True)
+                for w in range(nwork)]
+        threads = [lead] + pool
+        ingesters = pool
+    else:
+        lead = threading.Thread(target=prefetch,
+                                name="bolt-shuffle-prefetch",
+                                daemon=True)
+        threads = [lead]
+        ingesters = threads
+
+    def _spill_part(part, g):
+        """Extract and persist every LOCALLY-OWNED bucket of slab
+        ``g``'s transposed part (atomic files; the slab is marked
+        complete only after its last bucket lands — the kill -9
+        resume point)."""
+        for bkt in _owned_buckets(part, plan.out_block):
+            lo = bkt * plan.out_block
+            hi = min(lo + plan.out_block, plan.out_shape[0])
+            block = _bucket_host(part, lo, hi)
+            attempt = 0
+            prev = None
+            while True:
+                ssp = _obs.begin("stream.spill", slab=g, bucket=bkt)
+                try:
+                    _chaos.hit("stream.spill")
+                    nb = _ckptlib.spill_save(spill_dir, fp, g, bkt,
+                                             block, lo)
+                    if ssp is not None:
+                        ssp.set(bytes=nb)
+                    _obs.end(ssp)
+                    _engine.record_spill(nb)
+                    break
+                except BaseException as exc:  # noqa: BLE001
+                    _obs.end(ssp, error=type(exc).__name__)
+                    prev = _retry_or_raise(g, attempt, prev, exc,
+                                           "spill slab")
+                    attempt += 1
+        _ckptlib.spill_slab_done(spill_dir, fp, g)
+
+    t_start = _clock()
+    moved = 0
+    parts = []
+    pshapes = []
+    for th in threads:
+        th.start()
+    if mspec is not None:
+        _podwatch.pod_enter()
+    ready_done = False
+    try:
+        while True:
+            got = rsq.next(threads, workers=ingesters)
+            if got is None:
+                break
+            if mspec is not None and not ready_done:
+                _podwatch.ready_rendezvous()
+                ready_done = True
+            j, (g, buf, bnb, tsec) = got
+            wshape = (buf[0].shape if isinstance(buf, tuple)
+                      else buf.shape)
+            csp = _obs.begin("stream.compute", slab=g, shuffle=True)
+            attempt = 0
+            prev = None
+            try:
+                while True:
+                    try:
+                        # the chaos seam fires BEFORE the dispatch, so
+                        # an injected raise leaves the donated buffer
+                        # intact — the in-place retry (same fence as
+                        # ingest retries) re-dispatches it verbatim
+                        _chaos.hit("stream.shuffle")
+                        prog = _shuffle.rebucket_program(
+                            plan, pre, mesh, codec_obj, source.dtype,
+                            wshape, delta_ok)
+                        with warnings.catch_warnings():
+                            # CPU dev meshes have no donation: the
+                            # per-slab "donated buffers were not
+                            # usable" warning is expected noise there
+                            warnings.filterwarnings(
+                                "ignore", message="Some donated "
+                                "buffers were not usable")
+                            part = prog(buf)
+                        _pod_sync(part, mspec is not None,
+                                  "shuffle re-bucket", slab=g)
+                        break
+                    except _podwatch.PeerLostError:
+                        raise
+                    except BaseException as exc:  # noqa: BLE001
+                        prev = _retry_or_raise(g, attempt, prev, exc,
+                                               "shuffle dispatch")
+                        attempt += 1
+            finally:
+                _obs.end(csp)
+            del buf, got
+            moved += int(prod(part.shape)
+                         * np.dtype(part.dtype).itemsize)
+            if plan.resident:
+                parts.append((g, part))
+                pshapes.append(tuple(part.shape))
+            else:
+                _spill_part(part, g)
+                del part
+            permits.release()
+            if lease is not None:
+                lease.release(bnb)
+    finally:
+        stop.set()
+        for _ in range(len(threads)):
+            jobq.put(None)
+        for th in threads:
+            th.join()
+        rsq.drain()
+        if mspec is not None:
+            _podwatch.pod_exit()
+        if lease is not None:
+            lease.close()
+        _engine.record_shuffle(moved, _clock() - t_start)
+        if run_sp is not None:
+            run_sp.set(bytes=moved)
+        _obs.end(run_sp)
+
+    if plan.resident:
+        if not parts:
+            raise RuntimeError(
+                "streamed swap produced no slabs (empty source?) — "
+                "the materialised path owns empty-input rules")
+        # slab order was re-sequenced, but `done`-skips never happen
+        # resident (no manifest) — parts arrive in slab order already
+        parts = [p for _, p in sorted(parts, key=lambda t: t[0])]
+        prog = _shuffle.concat_program(plan, tuple(pshapes), mesh)
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            data = prog(*parts)
+        _pod_sync(data, mspec is not None, "shuffle concat")
+        del parts
+        b = BoltArrayTPU(data, new_split, mesh)
+        return _replay_stages(b, post)
+
+    # SPILLED: phase 2 is a fresh callback source over the bucket
+    # files — it streams through the SAME slab-program machinery as
+    # any other source (execute/materialize/retries/arbiter/resume all
+    # inherited), with the post-swap stages riding lazily
+    nslabs = plan.nslabs
+    out_shape = plan.out_shape
+    out_block = plan.out_block
+    j0 = plan.j0
+    out_n = out_shape[0]
+
+    def produce(index):
+        lo, hi, _ = index[0].indices(out_n)
+        chunks = []
+        for bkt in range(lo // out_block, -(-hi // out_block)):
+            pieces = [_ckptlib.spill_load(spill_dir, fp, g, bkt)
+                      for g in range(nslabs)]
+            blk = np.concatenate([p[0] for p in pieces], axis=j0)
+            chunks.append((pieces[0][1], blk))
+        full = np.concatenate([c[1] for c in chunks], axis=0)
+        row0 = chunks[0][0]
+        out = full[lo - row0:hi - row0]
+        return out[(slice(None),) + tuple(index[1:])]
+
+    src2 = StreamSource("callback", produce, None, out_shape, new_split,
+                        st.dtype, mesh, out_block, post,
+                        ckpt=source.ckpt, codec=None)
+    return BoltArrayTPU._streamed(src2)

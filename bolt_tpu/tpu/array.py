@@ -875,6 +875,25 @@ class BoltArrayTPU(BoltArray):
         self._aval = jax.ShapeDtypeStruct(tuple(data.shape), data.dtype)
         self._chain = None
 
+    def _adopt_resolved(self, res):
+        """Adopt the result of resolving this array's swap stages
+        (``stream.resolve_swaps`` — ISSUE 18): ``res`` is either still
+        streaming (a resident shuffle re-streams its buckets, a spilled
+        one streams them from disk) or concrete (the materialise
+        fallback).  Either way it IS this array's value — same shape,
+        dtype, split — so the identity simply re-seats on the resolved
+        representation and every later terminal sees a swap-free
+        source."""
+        if res._stream is not None:
+            self._stream = res._stream
+            self._concrete = None
+        else:
+            self._stream = None
+            self._concrete = res._concrete
+            self._chain = res._chain
+        self._split = res._split
+        self._aval = res._aval
+
     @property
     def keys(self):
         """Key-axis shape view (reference: ``bolt/spark/shapes.py :: Keys``)."""
@@ -2823,6 +2842,18 @@ class BoltArrayTPU(BoltArray):
         new_split = len(keys_rest) + len(vaxes)
         if perm == list(range(self.ndim)) and new_split == split:
             return self
+        if self._stream is not None:
+            # a STREAMED source records the swap as a lazy stage instead
+            # of materialising (ISSUE 18): the terminal that eventually
+            # consumes the chain resolves it through the two-phase
+            # shuffle (stream.resolve_swaps) — all-to-all re-bucketing
+            # slab by slab, spilling past the arbiter budget.
+            # NotImplemented = this swap is outside the streamed story
+            # (dynamic chain, lossy codec, pod iter source) and the
+            # materialise-first path below serves it bit-identically.
+            out = _streamlib.swap_stage(self, tuple(perm), new_split)
+            if out is not NotImplemented:
+                return out
         mesh = self._mesh
 
         if not donate:

@@ -206,6 +206,63 @@ class _Int8Codec(Codec):
         return (wire.astype(jnp.float32) * scale + zp).astype(dtype)
 
 
+class _DictCodec(Codec):
+    """LOSSLESS dictionary coding for low-cardinality INTEGER/bool
+    pipelines (ISSUE 18): host encode builds the slab's sorted value
+    dictionary (≤ 256 distinct values — IDs, labels, bucketed keys),
+    ships uint8 indices as the wire block with the 256-entry dictionary
+    as a per-slab sidecar, and the fused device decode is one gather
+    (``dictionary[indices]``) — bit-identical by construction, at
+    1/8 the wire bytes of an int64 key column.  This is the natural
+    encoding for spilled shuffle buckets of integer keys
+    (``checkpoint.spill_save`` applies it automatically), and a slab
+    with MORE than 256 distinct values raises a pointed ValueError
+    (the caller's cardinality contract, like int8's finite-values
+    contract — never a silent fallback).
+
+    Float pipelines are refused POINTEDLY: floating-point values are
+    not dictionary-shaped data, and the lossy cast codecs (or lossless
+    ``delta-f32``) are the float answer.  Sidecar codec → refused on
+    pods like int8 (``multihost.sidecar_codec_error``)."""
+
+    name = "dict"
+    lossless = True
+    sidecar = True
+
+    def wire_dtype(self, dtype):
+        dtype = np.dtype(dtype)
+        if not (np.issubdtype(dtype, np.integer)
+                or dtype == np.dtype(np.bool_)):
+            self._refuse(dtype, "dictionary coding is defined for "
+                                "integer/bool sources only — float "
+                                "values are not dictionary-shaped "
+                                "(use bf16/f16/int8/delta-f32 for "
+                                "float pipelines)")
+        return np.dtype(np.uint8)
+
+    def encode(self, block, delta_ok=True):
+        block = np.asarray(block)
+        self.wire_dtype(block.dtype)
+        values, inverse = np.unique(block, return_inverse=True)
+        if values.size > 256:
+            raise ValueError(
+                "codec 'dict' needs <= 256 distinct values per slab, "
+                "got %d: dictionary coding is for low-cardinality "
+                "key/label columns — stream this source uncompressed"
+                % values.size)
+        # the sidecar dictionary is PADDED to a fixed 256 entries so
+        # every slab shares one decode-program geometry (unused tail
+        # repeats the last value — indices never reach it)
+        table = np.empty(256, block.dtype)
+        table[:values.size] = values
+        table[values.size:] = values[-1] if values.size else 0
+        wire = inverse.reshape(block.shape).astype(np.uint8)
+        return wire, (table,)
+
+    def decode(self, wire, sidecar, dtype, delta_ok=True):
+        return sidecar[0][wire.astype(jnp.int32)].astype(dtype)
+
+
 class _DeltaF32Codec(Codec):
     """The LOSSLESS byte-plane-friendly codec for bit-exact float32
     pipelines: the raw bits (viewed as uint32) are delta-coded along
@@ -296,6 +353,7 @@ register(_CastCodec("bf16", _np_bf16))
 register(_CastCodec("f16", _np_f16))
 register(_Int8Codec())
 register(_DeltaF32Codec())
+register(_DictCodec())
 
 
 # ---------------------------------------------------------------------

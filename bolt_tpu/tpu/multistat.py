@@ -547,6 +547,13 @@ def _wrap(arr, group, handle):
 def _new_group(arr, axis, name, keepdims, ddof):
     from bolt_tpu.tpu.array import _chain_donate_ok
     mesh = arr._mesh
+    if arr._stream is not None and _streamlib.has_swap(arr._stream):
+        # a recorded swap resolves BEFORE the group forms (ISSUE 18):
+        # the two-phase shuffle re-seats the array on a swap-free
+        # source (or on concrete data if the shuffle fell back to
+        # materialise), and the group machinery below sees only
+        # geometry it already serves
+        _streamlib._swap_resolved(arr)
     if arr._stream is not None:
         g = _StatGroup("stream", mesh, arr._stream.split,
                        source=arr._stream)

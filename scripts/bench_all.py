@@ -163,6 +163,10 @@ def pipelines(mesh=None, nkeys=16):
     stream15 = bolt.fromcallback(lambda idx: x15[idx], (k, 8, 4), mesh,
                                  dtype=np.float32, chunks=max(1, k // 4),
                                  codec="bf16")
+    x16 = (np.arange(k * 8 * 4, dtype=np.int64) % 11).astype(
+        np.float32).reshape(k, 8, 4)
+    stream16 = bolt.fromcallback(lambda idx: x16[idx], (k, 8, 4), mesh,
+                                 dtype=np.float32, chunks=max(1, k // 4))
     return [
         ("1 map->sum", bolt.array(np.ones((k, 8, 4), np.float32),
                                   mesh).map(ADD1)),
@@ -186,6 +190,7 @@ def pipelines(mesh=None, nkeys=16):
         ("14 serve_smallreq", bolt.array(
             np.ones((k, 8, 4), np.float32), mesh).map(ADD1)),
         ("15 stream_codec", stream15.map(ADD1)),
+        ("16 stream_swap", stream16.swap((0,), (0,))),
     ]
 
 
@@ -694,6 +699,83 @@ def check_configs(mesh=None):
                      recomp15, leak_bytes15, leaked15,
                      "OK" if ok15 else "MISMATCH"))
             failed = failed or not ok15
+        if name.startswith("16"):
+            # the out-of-core shuffle gate (ISSUE 18): a swap recorded
+            # on a streamed source must (a) forecast its shuffle plan
+            # (BLT017) in AGREEMENT with the measured resident/spill
+            # decision — the checker runs the same planner against the
+            # same budget resolution as the dispatcher, so drift here
+            # is a real bug, (b) stay bit-identical to the
+            # materialise-first transpose on BOTH the resident and the
+            # forced-spill legs, (c) add ZERO fresh compiles on a
+            # second identical pass, and (d) leave nothing behind: no
+            # leaked spans, no arbiter bytes, no spill files after
+            # spill_clear.
+            import shutil as _sh16
+            import tempfile as _tf16
+            from bolt_tpu import checkpoint as _ckpt16
+            from bolt_tpu import serve as _serve16
+            from bolt_tpu import stream as _stream16
+            from bolt_tpu.parallel import default_mesh
+            mesh16 = mesh if mesh is not None else default_mesh()
+            k16 = 16
+            x16g = (np.arange(k16 * 8 * 4, dtype=np.int64) % 11).astype(
+                np.float32).reshape(k16, 8, 4)
+
+            def make16():
+                src = bolt.fromcallback(lambda idx: x16g[idx],
+                                        (k16, 8, 4), mesh16,
+                                        dtype=np.float32, chunks=4)
+                return src.swap((0,), (0,))
+
+            def blt017(a):
+                ds = [d for d in analysis.check(a).diagnostics
+                      if d.code == "BLT017"]
+                return ds[0] if ds else None
+
+            ref16 = np.transpose(x16g, (1, 0, 2))
+            td16 = _tf16.mkdtemp(prefix="bolt-gate16-")
+            with _serve16.serving(workers=1, budget_bytes=64 << 20) as sv:
+                d_res = blt017(make16())
+                c0 = engine.counters()
+                out_res = np.asarray(make16()._data)
+                c1 = engine.counters()
+                out_res2 = np.asarray(make16()._data)
+                c2 = engine.counters()
+                with _stream16.spill(dir=td16, budget=1):
+                    d_sp = blt017(make16())
+                    out_sp = np.asarray(make16()._data)
+                c3 = engine.counters()
+                leak_bytes16 = sv.stats()["arbiter"]["in_use_bytes"]
+            forecast_res = (d_res is not None and d_res.severity == "info"
+                            and "resident" in d_res.message)
+            forecast_sp = (d_sp is not None and d_sp.severity == "info"
+                           and "spill" in d_sp.message)
+            ran_res = (c1["spill_bytes"] == c0["spill_bytes"]
+                       and c1["shuffle_bytes"] > 0)
+            ran_sp = c3["spill_bytes"] > c1["spill_bytes"]
+            recomp16 = (c2["misses"] - c1["misses"]
+                        + c2["aot_compiles"] - c1["aot_compiles"])
+            spilled_files16 = _ckpt16.spill_pending(td16)
+            _ckpt16.spill_clear(td16)
+            cleared16 = not _ckpt16.spill_pending(td16)
+            _sh16.rmtree(td16, ignore_errors=True)
+            bit16 = (np.array_equal(out_res, ref16)
+                     and np.array_equal(out_res2, ref16)
+                     and np.array_equal(out_sp, ref16))
+            leaked16 = obs.active_count()
+            ok16 = (forecast_res and forecast_sp and ran_res and ran_sp
+                    and spilled_files16 and cleared16 and bit16
+                    and recomp16 == 0 and leaked16 == 0
+                    and leak_bytes16 == 0)
+            print("   stream_swap: BLT017 forecast resident %s / spill "
+                  "%s agree with measured %s/%s | bit-identical %s | "
+                  "recompiles on 2nd pass %d | leaked arbiter bytes %d "
+                  "| leaked spans %d | spill dir cleared %s -> %s"
+                  % (forecast_res, forecast_sp, ran_res, ran_sp, bit16,
+                     recomp16, leak_bytes16, leaked16, cleared16,
+                     "OK" if ok16 else "MISMATCH"))
+            failed = failed or not ok16
     obs.disable()
     # thread-census hygiene: every pool/watch/supervisor the configs
     # started must be torn down — a leaked bolt-* thread is an executor
@@ -1421,6 +1503,73 @@ def main():
     rows.append(_progress("15 stream_codec bf16 0.5GB", traw15, tb15,
                           "exact*" if ok15 else "MISMATCH"))
     del x15
+
+    # ---- config 16: out-of-core streamed swap (ISSUE 18) -------------
+    # the tentpole leg: a swap RECORDED on a streamed source resolves
+    # through the two-phase shuffle (per-slab on-device re-bucket
+    # overlapped with ingest, then a resident concat) instead of
+    # materialising the whole source first.  "local s" is the
+    # materialise-first baseline — cache() the full source into device
+    # memory, then the in-memory swap; "tpu s" is the streamed shuffle
+    # over the SAME callback source, so the speedup column is what
+    # overlapping the re-bucket with ingest buys on this attach.  The
+    # forced-spill leg (budget ~ one bucket: every re-keyed bucket
+    # rides the checkpoint-slab spill files to disk and phase 2
+    # re-streams them) rides along on stderr with its byte gauges.
+    import shutil as _sh16m
+    import tempfile as _tf16m
+    from bolt_tpu import checkpoint as _ckpt16m
+    shape16 = (2048, 256, 64)                     # 128 MB raw
+    x16 = lcg_np(shape16, salt=16)
+
+    def launch16():
+        src = bolt.fromcallback(lambda idx: x16[idx], shape16,
+                                mode="tpu", dtype=np.float32,
+                                chunks=256)
+        return src.swap((0,), (0,))
+
+    def mat16():
+        src = bolt.fromcallback(lambda idx: x16[idx], shape16,
+                                mode="tpu", dtype=np.float32,
+                                chunks=256)
+        src.cache()
+        return src.swap((0,), (0,))
+
+    with _stream.uploaders(4):
+        np.asarray(launch16()._data)              # compile both phases
+        t16s, t16m = float("inf"), float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out16m = np.asarray(mat16()._data)
+            t16m = min(t16m, time.perf_counter() - t0)
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out16s = np.asarray(launch16()._data)
+            t16s = min(t16s, time.perf_counter() - t0)
+        td16m = _tf16m.mkdtemp(prefix="bolt-bench16-")
+        try:
+            with _stream.spill(dir=td16m, budget=1):
+                t0 = time.perf_counter()
+                out16sp = np.asarray(launch16()._data)
+                t16sp = time.perf_counter() - t0
+            c16 = _profile.engine_counters()
+            stale16 = _ckpt16m.spill_pending(td16m)
+            _ckpt16m.spill_clear(td16m)
+        finally:
+            _sh16m.rmtree(td16m, ignore_errors=True)
+    bit16 = (np.array_equal(out16s, out16m)
+             and np.array_equal(out16sp, out16m)
+             and np.array_equal(out16m, np.transpose(x16, (1, 0, 2))))
+    ok16 = bit16 and stale16                      # the spill leg spilled
+    print("   stream_swap: %d MB streamed %.3fs vs materialise-first "
+          "%.3fs (%.2fx) | forced-spill %.3fs (spill %.0f MB, shuffle "
+          "%.0f MB moved) | all legs bit-identical %s"
+          % (x16.nbytes // 2**20, t16s, t16m, t16m / t16s, t16sp,
+             c16["spill_bytes"] / 1e6, c16["shuffle_bytes"] / 1e6,
+             bit16), file=sys.stderr)
+    rows.append(_progress("16 stream_swap 128MB", t16m, t16s,
+                          "exact" if ok16 else "MISMATCH"))
+    del x16
 
     print("%-26s %10s %10s %9s  %s" % ("config", "local s", "tpu s", "speedup", "parity"))
     for name, lt, tt, parity in rows:

@@ -22,7 +22,11 @@ each cell, either *recovery* (the fault is absorbed in place, or a
 re-run resumes bit-identically) or a *pointed error* (the fault
 surfaces as a named, actionable exception — never a hang, never silent
 corruption).  Seam drivers: the stream/checkpoint seams ride the
-subprocess streamed workload; the pod seams (heartbeat, barrier,
+subprocess streamed workload; the shuffle seams (ISSUE 18:
+``stream.shuffle``/``stream.spill``) ride a forced-spill streamed swap
+— raise is absorbed in place by the ``stream.retries`` fence, kill -9
+mid-spill resumes from the spill manifest bit-identically; the pod
+seams (heartbeat, barrier,
 supervisor elect/rejoin) ride a fake-peer pod fixture in a child
 process; ``multihost.collective`` rides a REAL 2-process localhost
 cluster (skipped without the CPU collective transport).  A seam added
@@ -237,6 +241,13 @@ _STREAM_NTH = {"stream.encode": 5, "stream.upload": 5,
                "stream.dispatch": 4, "stream.fold": 1,
                "stream.checkpoint": 3, "checkpoint.meta": 3,
                "checkpoint.corrupt": 3}
+# the shuffle seams (ISSUE 18) ride the forced-spill streamed swap:
+# stream.shuffle hits once per slab re-bucket dispatch (8 total),
+# stream.spill once per bucket write — nth=12 lands INSIDE a later
+# slab's bucket writes with at least one slab already fenced in the
+# manifest, whatever bucket width the planner picked for the local
+# device count
+_SHUFFLE_NTH = {"stream.shuffle": 4, "stream.spill": 12}
 _POD_NTH = {"podwatch.heartbeat": 3, "multihost.barrier": 1,
             "supervisor.elect": 1, "supervisor.rejoin": 1}
 
@@ -460,6 +471,103 @@ def _stream_cell(seam, mode, workdir):
     return ("recovered", "re-run resumed bit-identically")
 
 
+def shuffle_child_main(argv):
+    """One streamed FORCED-SPILL swap over the canonical workload (the
+    shuffle seams' kill target): ``stream.spill(dir, budget=1)`` makes
+    every re-keyed bucket spill through the checkpoint slab format, and
+    ``stream.retries(1)`` licenses the in-place retry the raise cells
+    assert.  Writes the swapped array plus a JSON sidecar of the
+    shuffle/spill counters; a SIGKILLed child writes neither — but its
+    spill manifest survives, which is the point."""
+    import jax
+    import bolt_tpu as bolt
+    from bolt_tpu import _chaos, checkpoint as ckpt, engine, stream
+
+    args = dict(zip(argv[::2], argv[1::2]))
+    spill_dir, out = args["--dir"], args["--out"]
+    for spec in filter(None, args.get("--arm", "").split(",")):
+        seam, nth, action = spec.split(":")
+        _chaos.inject(seam, nth=int(nth), action=action)
+    data = _data()
+
+    def loader(idx):
+        time.sleep(PACE_S)
+        return data[idx]
+
+    mesh = jax.make_mesh((jax.device_count(),), ("k",))
+    src = bolt.fromcallback(loader, data.shape, mesh, dtype=data.dtype,
+                            chunks=CHUNKS)
+    with stream.retries(1), stream.spill(dir=spill_dir, budget=1):
+        res = np.asarray(src.swap((0,), (0,))._data)
+    np.save(out, res)
+    ckpt.spill_clear(spill_dir)
+    ec = engine.counters()
+    with open(out + ".json", "w") as f:
+        json.dump({"retries": ec["stream_retries"],
+                   "resumes": ec["stream_resumes"],
+                   "spill_bytes": ec["spill_bytes"],
+                   "shuffle_bytes": ec["shuffle_bytes"],
+                   "stale_spill": ckpt.spill_pending(spill_dir)}, f)
+    return 0
+
+
+def _run_shuffle_child(spill_dir, out, arm=""):
+    env = dict(os.environ)
+    env["BOLT_STREAM_UPLOAD_THREADS"] = "1"
+    env.pop("BOLT_CHAOS", None)
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--shuffle-child",
+         "--dir", spill_dir, "--out", out, "--arm", arm],
+        env=env, capture_output=True, text=True, timeout=600)
+
+
+def _shuffle_cell(seam, mode, workdir):
+    """One shuffle-seam cell (ISSUE 18): raise is absorbed IN PLACE by
+    the armed ``stream.retries`` fence (same-run bit-identity, no
+    stale spill); kill -9 mid-spill leaves the fingerprint directory's
+    per-slab manifest, and the re-run RESUMES from it — skipping the
+    fenced slabs — bit-identically."""
+    from bolt_tpu import checkpoint as ckpt
+    tag = "%s-%s" % (seam.replace(".", "_"), mode)
+    sp = os.path.join(workdir, "spill-" + tag)
+    out = os.path.join(workdir, "out-" + tag + ".npy")
+    oracle = np.transpose(_data(), (1, 0, 2))
+    proc = _run_shuffle_child(
+        sp, out, arm="%s:%d:%s" % (seam, _SHUFFLE_NTH[seam], mode))
+    if mode == "raise":
+        if proc.returncode != 0:
+            return ("FAIL", "raise cell rc=%s:\n%s"
+                    % (proc.returncode, proc.stderr[-1500:]))
+        with open(out + ".json") as f:
+            sidecar = json.load(f)
+        if sidecar["retries"] < 1:
+            return ("FAIL", "fault was not absorbed by a stream retry")
+        if not np.array_equal(np.load(out), oracle):
+            return ("FAIL", "retried swap differs from the oracle")
+        if sidecar["stale_spill"]:
+            return ("FAIL", "run left stale spill files after clear")
+        return ("recovered", "fault absorbed in place by the "
+                             "stream.retries fence")
+    if proc.returncode != -9:
+        return ("FAIL", "kill child rc=%s (expected -9):\n%s"
+                % (proc.returncode, proc.stderr[-1500:]))
+    if not ckpt.spill_pending(sp):
+        return ("FAIL", "killed child left no spill manifest to resume")
+    proc = _run_shuffle_child(sp, out)
+    if proc.returncode != 0:
+        return ("FAIL", "resume child failed:\n%s" % proc.stderr[-1500:])
+    with open(out + ".json") as f:
+        sidecar = json.load(f)
+    if not np.array_equal(np.load(out), oracle):
+        return ("FAIL", "resumed swap differs from the oracle")
+    if sidecar["resumes"] < 1:
+        return ("FAIL", "re-run did not adopt the spill manifest")
+    if sidecar["stale_spill"]:
+        return ("FAIL", "resumed run left stale spill files after clear")
+    return ("recovered", "killed mid-spill; re-run resumes from the "
+                         "spill manifest bit-identically")
+
+
 def _collective_cell(seam, mode, workdir):
     """multihost.collective rides a REAL 2-process localhost cluster:
     the armed worker dies at a slab dispatch, the harness raises the
@@ -504,7 +612,9 @@ def run_matrix():
         for seam in _chaos.SEAMS:
             for mode in ("raise", "kill"):
                 t0 = time.monotonic()
-                if seam in _STREAM_NTH:
+                if seam in _SHUFFLE_NTH:
+                    outcome, detail = _shuffle_cell(seam, mode, workdir)
+                elif seam in _STREAM_NTH:
                     outcome, detail = _stream_cell(seam, mode, workdir)
                 elif seam in _POD_NTH:
                     outcome, detail = _pod_cell(seam, mode, workdir)
@@ -560,6 +670,8 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         sys.exit(child_main(sys.argv[2:]))
+    if "--shuffle-child" in sys.argv:
+        sys.exit(shuffle_child_main(sys.argv[2:]))
     if "--pod-child" in sys.argv:
         sys.exit(pod_child_main(sys.argv[2:]))
     if "--matrix" in sys.argv:

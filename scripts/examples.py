@@ -476,6 +476,46 @@ def main():
              c1["codec_bytes_wire"] - c0["codec_bytes_wire"]))
 
     # ------------------------------------------------------------------
+    section("8l. swap a dataset larger than HBM: the streamed shuffle")
+    # the ISSUE-18 tentpole: a swap RECORDED on a streamed source stays
+    # lazy and resolves as a two-phase shuffle — phase 1 re-buckets
+    # each slab on device as it lands (overlapping ingest), phase 2
+    # concatenates the resident buckets, or — past the budget — spills
+    # them through the checkpoint slab files and re-streams them.  The
+    # result is bit-identical to materialise-then-swap: a shuffle moves
+    # bytes, it never rounds.
+    import tempfile as _tf8l
+    from bolt_tpu import checkpoint as _ckpt8l
+    big8l = rs.randn(512, 64, 8).astype(np.float32)
+
+    def load8l():
+        return bolt.fromcallback(lambda idx: big8l[idx], big8l.shape,
+                                 mesh, dtype=np.float32, chunks=128)
+
+    swapped = load8l().swap((0,), (0,))   # lazy: nothing streamed yet
+    rep8l = bolt.analysis.check(swapped)
+    assert rep8l.has("BLT017")            # the shuffle-plan forecast
+    got8l = np.asarray(swapped._data)     # resolves the two phases
+    ref8l = np.transpose(big8l, (1, 0, 2))
+    assert np.array_equal(got8l, ref8l)   # BIT-identical
+    # force the out-of-core leg: a one-byte budget spills every
+    # re-keyed bucket to disk and phase 2 re-streams them — same bits;
+    # post-swap chunk().map() stages ride the re-streamed source
+    spill8l = _tf8l.mkdtemp(prefix="bolt-ex8l-")
+    with _stream8k.spill(dir=spill8l, budget=1):
+        out8l = (load8l().swap((0,), (0,))
+                 .chunk((16, 8)).map(lambda blk: blk * 2.0)
+                 .unchunk())
+        assert np.array_equal(np.asarray(out8l._data), ref8l * 2.0)
+    c8l = _engine8k.counters()
+    assert c8l["spill_bytes"] > 0         # the buckets really hit disk
+    _ckpt8l.spill_clear(spill8l)          # sweep the bolt-spill-* dir
+    print("  streamed swap bit-identical resident AND spilled "
+          "(shuffle %d KB moved, spill %d KB written, %.3fs)"
+          % (c8l["shuffle_bytes"] >> 10, c8l["spill_bytes"] >> 10,
+             c8l["shuffle_seconds"]))
+
+    # ------------------------------------------------------------------
     section("9. time-series pipeline: detrend -> zscore -> PCA")
     # per-pixel calcium-imaging-style workflow: remove each pixel's slow
     # drift, standardise, then find the dominant temporal components —

@@ -994,6 +994,58 @@ def payload_codec_pod(pid):
     return res
 
 
+def payload_swap(pid):
+    """The ISSUE-18 pod leg: a streamed ``swap`` re-buckets every slab
+    through ONE ``lax.all_to_all`` per slab inside shard_map (phase 1)
+    and concatenates the resident buckets (phase 2) — BIT-IDENTICAL on
+    every process to the materialise-first in-memory swap of the same
+    per-process source.  Also proves the pointed pod-spill refusal
+    (disk spill is single-process only) and zero leaked spans."""
+    import numpy as np
+    import bolt_tpu as bolt
+    from bolt_tpu import engine, obs, stream
+    from bolt_tpu.parallel import multihost
+    out = os.environ["BOLT_MH_OUT"]
+    n = int(os.environ.get("BOLT_MH_NKEYS", "64"))
+    vdim = 8
+    chunks = int(os.environ.get("BOLT_MH_CHUNKS", "16"))
+    x = _crafted(n, vdim)
+    mesh = _mesh()
+    obs.clear()
+    obs.enable()
+
+    def make():
+        return bolt.fromcallback(lambda idx: x[idx], (n, vdim), mesh,
+                                 dtype=np.float32, chunks=chunks,
+                                 per_process=True)
+
+    res = {"pid": pid, "nproc": multihost.process_count()}
+    c0 = engine.counters()
+    streamed = make().swap((0,), (0,))
+    res["lazy_after_swap"] = streamed._stream is not None
+    sval = _value(streamed)        # resolves the two-phase shuffle
+    c1 = engine.counters()
+    mat = make()
+    mat.cache()                    # materialise FIRST: the in-memory path
+    mval = _value(mat.swap((0,), (0,)))
+    np.save(os.path.join(out, "swap_streamed.%d.npy" % pid), sval)
+    np.save(os.path.join(out, "swap_materialised.%d.npy" % pid), mval)
+    res["shuffle_bytes"] = c1["shuffle_bytes"] - c0["shuffle_bytes"]
+    res["spill_bytes"] = c1["spill_bytes"] - c0["spill_bytes"]
+    # spill is single-process only: a pod plan past the budget refuses
+    # POINTEDLY before any rendezvous (symmetric on every process, so
+    # no peer is left hanging at the all-to-all)
+    try:
+        with stream.spill(dir=out, budget=1):
+            make().swap((0,), (0,))._data
+        res["pod_spill_refused"] = False
+    except RuntimeError as exc:
+        res["pod_spill_refused"] = "single-process" in str(exc)
+    res["leaked_spans"] = obs.active_count()
+    obs.disable()
+    return res
+
+
 def payload_sched_verify(pid):
     """The dispatch-schedule verifier's acceptance payload (ISSUE 17):
 
@@ -1052,6 +1104,7 @@ PAYLOADS = {
     "supervise": payload_supervise,
     "precollective": payload_precollective,
     "sched_verify": payload_sched_verify,
+    "swap": payload_swap,
 }
 
 

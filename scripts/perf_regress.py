@@ -457,6 +457,79 @@ def fam_stream_codec():
                          "stream_sum's")}
 
 
+def fam_stream_swap():
+    # the ISSUE-18 out-of-core shuffle family: a swap RECORDED on a
+    # streamed source resolves through the two-phase shuffle — phase 1
+    # re-buckets each uploaded slab on device the moment it lands,
+    # phase 2 concatenates the resident buckets — so the re-axis
+    # overlaps ingest instead of waiting for full HBM residency.
+    # s_per_iter is the STREAMED swap end to end (produce + upload +
+    # re-bucket + concat); the family records the materialise-first
+    # wall it replaces (cache() everything, then the in-memory swap),
+    # the forced-spill leg (budget ~ one bucket: every re-keyed bucket
+    # rides the checkpoint-slab spill files and phase 2 re-streams
+    # them from disk), the shuffle/spill byte gauges, and bit-identity
+    # of EVERY leg against the transpose oracle — a shuffle moves
+    # bytes, it never rounds.
+    import shutil
+    import tempfile
+    from bolt_tpu import stream as _stream
+
+    shape = (2048, 256, 64)                       # 128 MB raw
+    x = (np.arange(np.prod(shape), dtype=np.int64) % 251).astype(
+        np.float32).reshape(shape)
+
+    def streamed():
+        src = bolt.fromcallback(lambda idx: x[idx], shape, mode="tpu",
+                                dtype=np.float32, chunks=256)
+        return src.swap((0,), (0,))
+
+    def materialised():
+        src = bolt.fromcallback(lambda idx: x[idx], shape, mode="tpu",
+                                dtype=np.float32, chunks=256)
+        src.cache()                               # full HBM residency
+        return src.swap((0,), (0,))
+
+    def best_of(run, n=3):
+        best, out = float("inf"), None
+        for _ in range(n):
+            t0 = time.perf_counter()
+            out = np.asarray(run()._data)
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    with _stream.uploaders(4):
+        np.asarray(streamed()._data)              # compile both phases
+        streamed_s, got = best_of(streamed)
+        mat_s, ref = best_of(materialised)
+        td = tempfile.mkdtemp(prefix="bolt-perf-spill-")
+        try:
+            with _stream.spill(dir=td, budget=1):
+                t0 = time.perf_counter()
+                spilled = np.asarray(streamed()._data)
+                spill_s = time.perf_counter() - t0
+            sc = bolt.profile.engine_counters()
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+    bit = (np.array_equal(got, ref) and np.array_equal(spilled, ref)
+           and np.array_equal(ref, np.transpose(x, (1, 0, 2))))
+    eff = bolt.profile.overlap_efficiency()
+    return int(np.prod(shape)) * 4, streamed_s, {
+        "bound": "transfer",
+        "materialised_s": round(mat_s, 5),
+        "streamed_over_materialised": round(streamed_s / mat_s, 2),
+        "spill_s": round(spill_s, 5),
+        "spill_bytes": int(sc["spill_bytes"]),
+        "shuffle_bytes": int(sc["shuffle_bytes"]),
+        "bit_identical": bool(bit),
+        "overlap_efficiency": round(eff, 3),
+        "traffic": (2.0, "one host->device pass per input byte plus "
+                         "the on-device re-bucket (read + transposed "
+                         "write; a mesh's all_to_all exchange rides on "
+                         "top); the forced-spill leg adds a disk round "
+                         "trip per byte past the budget")}
+
+
 def fam_multi_stat_fused():
     # the ISSUE-7 fused multi-stat terminal: bolt.compute(m.sum(),
     # m.var(), m.min(), m.max()) — four terminals from ONE read of a
@@ -933,6 +1006,7 @@ FAMILIES = [
     ("jacobi_eigh", fam_jacobi_eigh),
     ("stream_sum", fam_stream_sum),
     ("stream_codec", fam_stream_codec),
+    ("stream_swap", fam_stream_swap),
     ("multi_stat_fused", fam_multi_stat_fused),
     ("serve_multitenant", fam_serve_multitenant),
     ("serve_smallreq", fam_serve_smallreq),
@@ -1146,7 +1220,13 @@ def main():
                     "batch_occupancy_mean", "dispatches_per_request",
                     "batched_dispatches", "batched_requests",
                     "qps_curve", "qps_curve_batched",
-                    "qps_curve_unbatched", "p50_low_qps_ratio"):
+                    "qps_curve_unbatched", "p50_low_qps_ratio",
+                    # stream_swap (ISSUE 18): out-of-core shuffle
+                    # observables — the materialise-first wall it
+                    # replaces, the forced-spill leg, and the
+                    # shuffle/spill byte gauges
+                    "materialised_s", "streamed_over_materialised",
+                    "spill_s", "spill_bytes", "shuffle_bytes"):
             if meta.get(key) is not None:
                 entry[key] = meta[key]
         if phases:

@@ -312,6 +312,8 @@ _EXPECTED_ENGINE_KEYS = {
     "batched_dispatches": False, "batched_requests": False,
     "codec_encode_seconds": True, "codec_bytes_raw": False,
     "codec_bytes_wire": False,
+    "shuffle_bytes": False, "spill_bytes": False,
+    "shuffle_seconds": True,
 }
 
 
